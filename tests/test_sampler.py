@@ -1,0 +1,100 @@
+"""DistributedSampler semantics (SURVEY.md §2b #12) — the reference's manual
+shard-disjointness probe (multi-GPU-training-torch.py:112-115) turned into
+real asserts, plus padding and set_epoch contracts."""
+
+import numpy as np
+import pytest
+
+from tpuddp.parallel import DistributedSampler
+
+
+def shards(n, world, **kw):
+    samplers = [DistributedSampler(n, world, r, **kw) for r in range(world)]
+    return samplers, [s.local_indices() for s in samplers]
+
+
+def test_shards_disjoint_and_cover():
+    _, parts = shards(64, 8, seed=1)
+    all_idx = np.concatenate(parts)
+    assert len(all_idx) == 64
+    assert set(all_idx.tolist()) == set(range(64))
+    for i in range(8):
+        for j in range(i + 1, 8):
+            assert not set(parts[i]) & set(parts[j])
+
+
+def test_equal_shard_sizes_with_padding():
+    # 100 samples over 8 ranks -> ceil = 13 each, 104 total with 4 repeats
+    samplers, parts = shards(100, 8)
+    assert all(len(p) == 13 for p in parts)
+    assert all(len(s) == 13 for s in samplers)
+    counts = np.bincount(np.concatenate(parts), minlength=100)
+    assert counts.min() == 1 and counts.max() == 2 and counts.sum() == 104
+
+
+def test_padding_wraps_head_samples_when_not_shuffled():
+    s = DistributedSampler(10, 4, 0, shuffle=False)
+    # global order is 0..9 + [0, 1] pad; rank 0 takes stride-4: [0, 4, 8]
+    assert s.local_indices().tolist() == [0, 4, 8]
+    s3 = DistributedSampler(10, 4, 3, shuffle=False)
+    assert s3.local_indices().tolist() == [3, 7, 1]  # 1 is the wrapped pad
+
+
+def test_pad_larger_than_dataset():
+    s = DistributedSampler(3, 8, 7, shuffle=False)
+    assert len(s.local_indices()) == 1
+    all_idx = np.concatenate([DistributedSampler(3, 8, r, shuffle=False).local_indices() for r in range(8)])
+    assert all_idx.tolist() == [0, 1, 2, 0, 1, 2, 0, 1]
+
+
+def test_drop_last_trims():
+    samplers, parts = shards(100, 8, drop_last=True)
+    assert all(len(p) == 12 for p in parts)
+    assert len(np.concatenate(parts)) == 96
+
+
+def test_set_epoch_reshuffles_and_is_deterministic():
+    s = DistributedSampler(50, 2, 0, seed=7)
+    s.set_epoch(0)
+    e0 = s.local_indices()
+    s.set_epoch(1)
+    e1 = s.local_indices()
+    assert not np.array_equal(e0, e1)  # reshuffled
+    s.set_epoch(0)
+    assert np.array_equal(s.local_indices(), e0)  # deterministic replay
+
+
+def test_without_set_epoch_order_repeats():
+    # The pitfall the reference's toggle reproduces (README.md:82-84).
+    s = DistributedSampler(50, 2, 0, seed=7)
+    a = s.local_indices()
+    b = s.local_indices()
+    assert np.array_equal(a, b)
+
+
+def test_ranks_share_permutation():
+    # same seed+epoch => same global permutation, different strided slices
+    a = DistributedSampler(16, 4, 1, seed=3)
+    b = DistributedSampler(16, 4, 1, seed=3)
+    assert np.array_equal(a.local_indices(), b.local_indices())
+
+
+def test_no_shuffle_is_strided_arange():
+    s = DistributedSampler(8, 4, 2, shuffle=False)
+    assert list(s) == [2, 6]
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        DistributedSampler(10, 4, 4)
+    with pytest.raises(ValueError):
+        DistributedSampler(10, None, None)
+
+
+def test_len_protocol_accepts_dataset_object():
+    class DS:
+        def __len__(self):
+            return 12
+
+    s = DistributedSampler(DS(), 4, 0, shuffle=False)
+    assert len(s) == 3

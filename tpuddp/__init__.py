@@ -1,0 +1,34 @@
+"""tpuddp — a TPU-native distributed data-parallel training framework.
+
+A brand-new JAX/XLA framework with the capabilities of the
+`tutorial-torch-distributed-data-parallel` reference, redesigned TPU-first:
+
+- ``tpuddp.parallel``  — distributed runtime: backend ladder (TPU -> CPU -> error,
+  mirroring the reference's NCCL -> Gloo -> error ladder at
+  multi-GPU-training-torch.py:34-42), device mesh with a named ``"data"`` axis,
+  XLA collectives over ICI/DCN, an exact-semantics ``DistributedSampler``, and a
+  ``DistributedDataParallel`` wrapper whose gradient averaging is ``lax.pmean``
+  inside a ``shard_map``-ped, jitted train step.
+- ``tpuddp.nn``        — a functional neural-net layer library (Linear, Conv2d,
+  BatchNorm with cross-replica statistic sync = the SyncBatchNorm contract from
+  the reference README.md:79-81, pooling, dropout, losses).
+- ``tpuddp.optim``     — native optimizers (Adam, SGD) as pure pytree transforms.
+- ``tpuddp.models``    — model zoo: toy MLP, toy CNN (+SyncBN), AlexNet-class CNN
+  (reference data_and_toy_model.py:41-45), ResNet-18.
+- ``tpuddp.data``      — CIFAR-10 pipeline with *device-side* augmentation
+  (uint8 32x32 is shipped to HBM; resize/flip/normalize run fused on-chip),
+  synthetic datasets for CI.
+- ``tpuddp.training``  — jitted DP train/eval steps, the epoch driver
+  (reference run_training_loop, multi-GPU-training-torch.py:156-225), and
+  checkpoint/resume.
+- ``tpuddp.accelerate``— a managed ``Accelerator`` facade (HuggingFace-accelerate
+  API shape: prepare/backward/is_local_main_process/wait_for_everyone/save_model)
+  routed through the same XLA backend as the explicit API.
+"""
+
+__version__ = "0.1.0"
+
+from tpuddp import parallel  # noqa: F401
+from tpuddp import seeding  # noqa: F401
+
+__all__ = ["parallel", "seeding", "__version__"]

@@ -1,0 +1,43 @@
+"""Distributed runtime for tpuddp: backends, meshes, collectives, sampling, DDP."""
+
+from tpuddp.parallel.backend import (  # noqa: F401
+    BackendUnavailableError,
+    available_backends,
+    cleanup,
+    detect_backend,
+    get_backend,
+    get_rank,
+    get_world_size,
+    is_initialized,
+    setup,
+)
+from tpuddp.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    data_sharded,
+    data_mesh,
+    local_mesh_devices,
+    make_mesh,
+    replicated,
+)
+from tpuddp.parallel import collectives  # noqa: F401
+from tpuddp.parallel.sampler import DistributedSampler  # noqa: F401
+
+__all__ = [
+    "BackendUnavailableError",
+    "available_backends",
+    "cleanup",
+    "detect_backend",
+    "get_backend",
+    "get_rank",
+    "get_world_size",
+    "is_initialized",
+    "setup",
+    "DATA_AXIS",
+    "data_mesh",
+    "data_sharded",
+    "local_mesh_devices",
+    "make_mesh",
+    "replicated",
+    "collectives",
+    "DistributedSampler",
+]

@@ -1,0 +1,141 @@
+"""XLA collectives over ICI/DCN — the tpuddp communication backend.
+
+This module owns the contracts the reference delegates to torch.distributed /
+NCCL (SURVEY.md §2b #11):
+
+- ``all_reduce``    ~ ``dist.all_reduce`` (default SUM), used x5 per epoch for
+                     metric aggregation (multi-GPU-training-torch.py:198-204)
+- ``pmean``         ~ DDP's gradient averaging (the implicit allreduce inside
+                     ``loss.backward()``, multi-GPU-training-torch.py:125)
+- ``barrier``       ~ ``dist.barrier()`` (multi-GPU-training-torch.py:194,223)
+- ``broadcast_one_to_all`` ~ DDP's rank-0 parameter broadcast at wrap time
+                     (multi-GPU-training-torch.py:245)
+
+The in-jit functions (psum/pmean/all_gather/...) are thin, named wrappers over
+``jax.lax`` collectives: on TPU these compile to XLA collective ops scheduled
+on ICI (intra-slice) or DCN (inter-slice) — there is no NCCL-style runtime to
+manage. They must be called inside ``shard_map``/``pmap`` with a live axis name
+(tpuddp uses ``"data"``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import multihost_utils
+
+from tpuddp.parallel.mesh import DATA_AXIS
+
+# ---------------------------------------------------------------------------
+# In-jit collectives (require an active named axis, e.g. inside shard_map).
+# ---------------------------------------------------------------------------
+
+_REDUCE_OPS = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax, "min": lax.pmin}
+
+
+def all_reduce(x, op: str = "sum", axis_name: str = DATA_AXIS):
+    """All-reduce a value (or pytree) across the named axis. Default op=sum,
+    matching ``dist.all_reduce``'s default ReduceOp.SUM."""
+    try:
+        fn = _REDUCE_OPS[op]
+    except KeyError:
+        raise ValueError(f"unknown reduce op {op!r}; one of {sorted(_REDUCE_OPS)}")
+    return jax.tree_util.tree_map(partial(fn, axis_name=axis_name), x)
+
+
+def psum(x, axis_name: str = DATA_AXIS):
+    return all_reduce(x, "sum", axis_name)
+
+
+def pmean(x, axis_name: str = DATA_AXIS):
+    """Cross-replica mean — the DDP gradient-averaging contract."""
+    return all_reduce(x, "mean", axis_name)
+
+
+def pmax(x, axis_name: str = DATA_AXIS):
+    return all_reduce(x, "max", axis_name)
+
+
+def all_gather(x, axis_name: str = DATA_AXIS, axis: int = 0, tiled: bool = False):
+    return jax.tree_util.tree_map(
+        lambda v: lax.all_gather(v, axis_name, axis=axis, tiled=tiled), x
+    )
+
+
+def reduce_scatter(x, axis_name: str = DATA_AXIS, scatter_dimension: int = 0):
+    return jax.tree_util.tree_map(
+        lambda v: lax.psum_scatter(
+            v, axis_name, scatter_dimension=scatter_dimension, tiled=True
+        ),
+        x,
+    )
+
+
+def ppermute(x, perm, axis_name: str = DATA_AXIS):
+    """Point-to-point ring permutation (building block for ring algorithms)."""
+    return jax.tree_util.tree_map(
+        lambda v: lax.ppermute(v, axis_name, perm=perm), x
+    )
+
+
+def axis_index(axis_name: str = DATA_AXIS):
+    """This replica's index along the axis — the in-jit notion of "rank"."""
+    return lax.axis_index(axis_name)
+
+
+def broadcast(x, root: int = 0, axis_name: str = DATA_AXIS):
+    """In-jit broadcast from ``root``: every replica gets root's value.
+
+    Implements DDP's rank-0 parameter/buffer broadcast semantics. Uses a
+    select+psum so it stays a single fused collective.
+    """
+
+    def _bcast(v):
+        idx = lax.axis_index(axis_name)
+        masked = jnp.where(idx == root, v, jnp.zeros_like(v))
+        return lax.psum(masked, axis_name)
+
+    return jax.tree_util.tree_map(_bcast, x)
+
+
+# ---------------------------------------------------------------------------
+# Host-level operations (called from the training loop, not inside jit).
+# ---------------------------------------------------------------------------
+
+
+def barrier(tag: str = "tpuddp_barrier", wait_for=None) -> None:
+    """Synchronize. Analog of ``dist.barrier()`` (multi-GPU-training-torch.py:194,223).
+
+    On a single host, device work is ordered by XLA's async dispatch stream, so
+    the barrier reduces to (optionally) blocking on in-flight values. Across
+    hosts it is a real global rendezvous over DCN.
+    """
+    if wait_for is not None:
+        jax.block_until_ready(wait_for)
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices(tag)
+
+
+def broadcast_one_to_all(pytree, is_source: Optional[bool] = None):
+    """Host-level broadcast of a pytree from process 0 to all processes —
+    the multi-host analog of DDP's construction-time parameter broadcast.
+    Single-process: identity (params are already one copy shared by all chips).
+    """
+    if jax.process_count() == 1:
+        return pytree
+    return multihost_utils.broadcast_one_to_all(pytree, is_source=is_source)
+
+
+def host_sum(x):
+    """Sum a metric array that is sharded across devices (shape [world] from a
+    per-shard shard_map output) into a single host scalar — the epoch-end
+    ``dist.all_reduce`` of the reference (multi-GPU-training-torch.py:198-204).
+
+    Under jit, the sum over the sharded axis compiles to an XLA cross-device
+    reduction; the result is replicated and fetched once.
+    """
+    return jax.jit(jnp.sum)(x)

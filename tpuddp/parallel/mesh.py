@@ -1,0 +1,85 @@
+"""Device mesh + sharding helpers.
+
+The reference's notion of a "world" is N single-GPU processes joined by NCCL
+(multi-GPU-training-torch.py:269-279). The TPU-native notion is a
+``jax.sharding.Mesh`` over all chips with a named ``"data"`` axis; data
+parallelism = batch sharded over that axis, parameters replicated. The axis is
+*named* so that later tensor/pipeline axes can be added to the same mesh
+without redesign (SURVEY.md §2c build consequence).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuddp.parallel import backend as _backend
+
+DATA_AXIS = "data"
+
+
+def local_mesh_devices(
+    world_size: Optional[int] = None, backend: Optional[str] = None
+) -> Sequence[jax.Device]:
+    """Devices forming the data-parallel world (see backend.resolve_devices)."""
+    return _backend.resolve_devices(world_size, backend)
+
+
+def make_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    axes: Optional[Mapping[str, int]] = None,
+    backend: Optional[str] = None,
+) -> Mesh:
+    """Create a mesh. Default: 1-D mesh over all resolved devices, axis "data".
+
+    ``axes`` maps axis names to sizes, e.g. ``{"data": 4, "model": 2}``; sizes
+    must multiply to the device count. Data parallelism only needs the default,
+    but the mesh abstraction is N-D from day one.
+    """
+    if devices is None:
+        devices = local_mesh_devices(backend=backend)
+    devices = np.asarray(devices, dtype=object)
+    if axes is None:
+        axes = {DATA_AXIS: devices.size}
+    names = tuple(axes.keys())
+    sizes = tuple(axes.values())
+    if int(np.prod(sizes)) != devices.size:
+        raise ValueError(f"mesh axes {dict(axes)} do not tile {devices.size} devices")
+    return Mesh(devices.reshape(sizes), names)
+
+
+def data_mesh(world_size: Optional[int] = None, backend: Optional[str] = None) -> Mesh:
+    """1-D data-parallel mesh — the DP world the reference builds with mp.spawn."""
+    return make_mesh(local_mesh_devices(world_size, backend))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding for parameters/optimizer state: replicated on every device
+    (the DDP contract: replica-identical params, multi-GPU-training-torch.py:245)."""
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Sharding for a batch: leading axis split over the "data" mesh axis."""
+    spec = P(DATA_AXIS, *([None] * (ndim - 1))) if ndim > 1 else P(DATA_AXIS)
+    return NamedSharding(mesh, spec)
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host batch onto the mesh, split over the data axis.
+
+    Single-process: a plain ``device_put`` with a data-sharded NamedSharding.
+    Multi-process: each process passes its *local* shard (what its sampler
+    loaded) and the global array is assembled across hosts — the TPU-native
+    replacement for N dataloaders feeding N processes.
+    """
+    def _put(x):
+        sharding = NamedSharding(mesh, P(DATA_AXIS, *([None] * (x.ndim - 1))))
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+        return jax.device_put(x, sharding)
+
+    return jax.tree_util.tree_map(_put, batch)

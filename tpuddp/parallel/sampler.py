@@ -1,0 +1,100 @@
+"""DistributedSampler — exact-semantics, torch-free reimplementation.
+
+Owns the contract the reference delegates to
+``torch.utils.data.DistributedSampler`` (SURVEY.md §2b #12), exercised at
+multi-GPU-training-torch.py:80-83,175-178:
+
+- per-epoch deterministic permutation keyed by ``seed + epoch`` via
+  :meth:`set_epoch` — without it, every epoch replays the same order (the
+  pitfall documented at reference README.md:82-84);
+- pads the index list by wrapping (repeating head samples) until its length is
+  divisible by ``num_replicas`` (or drops the tail with ``drop_last``);
+- each rank takes the strided slice ``indices[rank::num_replicas]`` — shards
+  are disjoint and equal-sized.
+
+The permutation source is numpy PCG64 rather than torch's Philox, so the
+*semantics* (deterministic, epoch-keyed, identical across ranks) match while
+the concrete ordering differs — which the reference never depends on.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Optional, Sized, Union
+
+import numpy as np
+
+
+class DistributedSampler:
+    """Shards dataset indices across the data-parallel world.
+
+    Parameters mirror torch's: ``dataset`` (anything with ``len``, or an int
+    length), ``num_replicas``, ``rank``, ``shuffle``, ``seed``, ``drop_last``.
+    """
+
+    def __init__(
+        self,
+        dataset: Union[Sized, int],
+        num_replicas: Optional[int] = None,
+        rank: Optional[int] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if num_replicas is None or rank is None:
+            raise ValueError("num_replicas and rank are required")
+        if not (0 <= rank < num_replicas):
+            raise ValueError(f"rank {rank} not in [0, {num_replicas})")
+        self.dataset_len = dataset if isinstance(dataset, int) else len(dataset)
+        self.num_replicas = int(num_replicas)
+        self.rank = int(rank)
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.drop_last = bool(drop_last)
+        self.epoch = 0
+
+        if self.drop_last and self.dataset_len % self.num_replicas != 0:
+            self.num_samples = self.dataset_len // self.num_replicas
+        else:
+            self.num_samples = math.ceil(self.dataset_len / self.num_replicas)
+        self.total_size = self.num_samples * self.num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        """Re-key the shuffle for a new epoch (reference usage at
+        multi-GPU-training-torch.py:175-178). Must be called before iterating
+        each epoch, on every rank, with the same value."""
+        self.epoch = int(epoch)
+
+    def _global_indices(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.Generator(np.random.PCG64(self.seed + self.epoch))
+            indices = rng.permutation(self.dataset_len)
+        else:
+            indices = np.arange(self.dataset_len)
+
+        if not self.drop_last:
+            padding = self.total_size - len(indices)
+            if padding > 0:
+                if padding <= len(indices):
+                    indices = np.concatenate([indices, indices[:padding]])
+                else:
+                    reps = math.ceil(padding / len(indices))
+                    indices = np.concatenate(
+                        [indices, np.tile(indices, reps)[:padding]]
+                    )
+        else:
+            indices = indices[: self.total_size]
+        assert len(indices) == self.total_size
+        return indices
+
+    def local_indices(self) -> np.ndarray:
+        """This rank's disjoint strided shard of the epoch permutation."""
+        shard = self._global_indices()[self.rank : self.total_size : self.num_replicas]
+        assert len(shard) == self.num_samples
+        return shard
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.local_indices().tolist())
+
+    def __len__(self) -> int:
+        return self.num_samples

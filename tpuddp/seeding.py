@@ -1,0 +1,88 @@
+"""Rank-aware seeding — parity with reference ``set_seed_based_on_rank``
+(multi-GPU-training-torch.py:54-69).
+
+The reference derives each process's seeds from ``torch.initial_seed()`` (which
+``mp.spawn`` randomizes per run and varies per rank), re-seeding torch at
+``initial + rank`` and Python/NumPy at ``initial % (2**32 - 1) + rank`` — the
+deliberately different seed range quirk is preserved here.
+
+The JAX-native analog is a single base seed folded with the process index into
+a ``jax.random`` key; *device-level* divergence (e.g. per-replica dropout) is
+done inside jit by folding in ``lax.axis_index`` — see
+:func:`fold_in_axis_index`. ``cudnn.deterministic`` (reference :63-64) has no
+TPU knob: XLA on TPU is deterministic by default; we log for API parity.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import struct
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax import lax
+
+logger = logging.getLogger("tpuddp")
+
+_last_base_seed: Optional[int] = None
+
+
+def initial_seed() -> int:
+    """A fresh random base seed (analog of torch's per-run ``initial_seed``)."""
+    return struct.unpack("<Q", os.urandom(8))[0] >> 1  # non-negative int64
+
+
+def set_seed_based_on_rank(
+    rank: Optional[int] = None, base_seed: Optional[int] = None
+) -> Tuple[jax.Array, int]:
+    """Seed Python/NumPy and derive this process's JAX PRNG key.
+
+    Returns ``(key, base_seed)``. Pass the returned ``base_seed`` to other
+    processes (or set it in config) so ranks differ only by the fold. With
+    ``base_seed=None`` a fresh one is drawn per run, like torch's initial seed.
+    """
+    global _last_base_seed
+    if rank is None:
+        rank = jax.process_index()
+    if base_seed is None:
+        base_seed = initial_seed()
+    _last_base_seed = base_seed
+
+    # JAX side: fold the rank into the base key (analog of torch.manual_seed(initial + rank)).
+    key = jax.random.fold_in(jax.random.key(base_seed % (2**63)), rank)
+
+    # Python/NumPy side: reduced seed range + rank, exactly the reference quirk.
+    reduced_seed = int(base_seed) % (2**32 - 1)
+    random.seed(reduced_seed + rank)
+    np.random.seed((reduced_seed + rank) % (2**32))
+
+    # Reference sets cudnn.deterministic=True here; XLA/TPU is deterministic by
+    # default, so this is a logged no-op kept for API parity (SURVEY.md §2b #17).
+    logger.debug("deterministic execution: XLA/TPU default (no cudnn knob needed)")
+    return key, base_seed
+
+
+def last_base_seed() -> Optional[int]:
+    """The base seed from the most recent set_seed_based_on_rank call — the
+    analog of ``torch.initial_seed()`` for the print_rand debug probe
+    (multi-GPU-training-torch.py:180-183)."""
+    return _last_base_seed
+
+
+def rng_probe_string() -> str:
+    """Formatted RNG-state dump matching the reference's print_rand probe."""
+    py_state = random.getstate()[1][:3]
+    np_state = np.random.get_state()[1][:3]
+    return (
+        f"Python random state: {py_state}, numpy random state: {tuple(np_state)}; "
+        f"base seed: {_last_base_seed}"
+    )
+
+
+def fold_in_axis_index(key: jax.Array, axis_name: str = "data") -> jax.Array:
+    """Inside shard_map/pmap: derive a per-replica key (device-level rank fold),
+    so e.g. dropout masks differ across replicas."""
+    return jax.random.fold_in(key, lax.axis_index(axis_name))
