@@ -21,6 +21,12 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# The sandbox's sitecustomize imports jax (registering a TPU plugin) before any
+# env var set here can take effect, so JAX_PLATFORMS alone cannot force CPU.
+# Route all default placements to the host platform explicitly: tests must be
+# runnable — and deterministic in f32 — without touching a real TPU.
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
 WORLD = 8
 
 

@@ -1,0 +1,188 @@
+"""nn layer correctness, with torch (CPU) as the numerical oracle where the
+reference stack defines the semantics (BatchNorm buffers, adaptive pooling,
+cross-entropy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from tpuddp import nn
+
+KEY = jax.random.key(0)
+
+
+def ctx_train(rng=None, axis_name=None):
+    return nn.Context(train=True, rng=rng, axis_name=axis_name)
+
+
+def test_linear_shapes_and_math():
+    x = jnp.ones((4, 16))
+    layer = nn.Linear(8)
+    params, state = layer.init(KEY, x)
+    assert params["weight"].shape == (16, 8)
+    y, _ = layer.apply(params, state, x, nn.Context())
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(x @ params["weight"] + params["bias"]), rtol=1e-6
+    )
+
+
+def test_linear_init_bound_matches_torch_scheme():
+    x = jnp.ones((2, 100))
+    params, _ = nn.Linear(50).init(KEY, x)
+    bound = 1 / np.sqrt(100)
+    w = np.asarray(params["weight"])
+    assert w.min() >= -bound and w.max() <= bound
+    assert w.std() == pytest.approx(bound / np.sqrt(3), rel=0.1)
+
+
+def test_conv2d_matches_torch():
+    x = np.random.RandomState(0).randn(2, 8, 8, 3).astype(np.float32)
+    layer = nn.Conv2d(5, kernel_size=3, strides=2, padding=1)
+    params, state = layer.init(KEY, jnp.asarray(x))
+    y, _ = layer.apply(params, state, jnp.asarray(x), nn.Context())
+    # torch oracle: NCHW / OIHW
+    w = np.asarray(params["weight"]).transpose(3, 2, 0, 1)  # HWIO -> OIHW
+    ref = F.conv2d(
+        torch.from_numpy(x.transpose(0, 3, 1, 2)),
+        torch.from_numpy(w),
+        torch.from_numpy(np.asarray(params["bias"])),
+        stride=2,
+        padding=1,
+    ).numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_maxpool_matches_torch():
+    x = np.random.RandomState(1).randn(2, 9, 9, 4).astype(np.float32)
+    layer = nn.MaxPool2d(3, strides=2)
+    y, _ = layer.apply((), (), jnp.asarray(x), nn.Context())
+    ref = F.max_pool2d(torch.from_numpy(x.transpose(0, 3, 1, 2)), 3, 2).numpy()
+    np.testing.assert_allclose(np.asarray(y), ref.transpose(0, 2, 3, 1), rtol=1e-6)
+
+
+@pytest.mark.parametrize("in_hw,out_hw", [(13, 6), (7, 7), (8, 4), (5, 3)])
+def test_adaptive_avg_pool_matches_torch(in_hw, out_hw):
+    x = np.random.RandomState(2).randn(2, in_hw, in_hw, 3).astype(np.float32)
+    layer = nn.AdaptiveAvgPool2d(out_hw)
+    y, _ = layer.apply((), (), jnp.asarray(x), nn.Context())
+    ref = F.adaptive_avg_pool2d(
+        torch.from_numpy(x.transpose(0, 3, 1, 2)), out_hw
+    ).numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_train_eval_and_rng():
+    x = jnp.ones((100, 100))
+    layer = nn.Dropout(0.5)
+    y_eval, _ = layer.apply((), (), x, nn.Context())
+    np.testing.assert_array_equal(np.asarray(y_eval), np.ones((100, 100)))
+    y_train, _ = layer.apply((), (), x, ctx_train(jax.random.key(1)))
+    kept = np.asarray(y_train) != 0
+    assert 0.4 < kept.mean() < 0.6
+    assert np.allclose(np.asarray(y_train)[kept], 2.0)  # inverted scaling
+    with pytest.raises(ValueError):
+        layer.apply((), (), x, ctx_train(rng=None))
+
+
+def test_batchnorm_matches_torch_train_and_eval():
+    x = np.random.RandomState(3).randn(8, 4, 4, 5).astype(np.float32) * 3 + 1
+    layer = nn.BatchNorm()
+    params, state = layer.init(KEY, jnp.asarray(x))
+    y, new_state = layer.apply(params, state, jnp.asarray(x), ctx_train())
+
+    bn = torch.nn.BatchNorm2d(5)
+    bn.train()
+    ref = bn(torch.from_numpy(x.transpose(0, 3, 1, 2))).detach().numpy()
+    np.testing.assert_allclose(np.asarray(y), ref.transpose(0, 2, 3, 1), rtol=1e-3, atol=1e-4)
+    # running buffers (torch keeps unbiased var in the buffer)
+    np.testing.assert_allclose(np.asarray(new_state["mean"]), bn.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state["var"]), bn.running_var.numpy(), rtol=1e-4, atol=1e-5)
+
+    # eval mode uses the buffers
+    bn.eval()
+    y2, same_state = layer.apply(params, new_state, jnp.asarray(x), nn.Context())
+    ref2 = bn(torch.from_numpy(x.transpose(0, 3, 1, 2))).detach().numpy()
+    np.testing.assert_allclose(np.asarray(y2), ref2.transpose(0, 2, 3, 1), rtol=1e-3, atol=1e-4)
+    assert same_state is new_state  # eval must not touch buffers
+
+
+def test_sync_batchnorm_equals_global_batch_stats(mesh):
+    """The SyncBatchNorm contract (SURVEY §2b #16): per-shard BN with sync=True
+    must equal single-device BN over the full global batch."""
+    from jax.sharding import PartitionSpec as P
+
+    x = np.random.RandomState(4).randn(16, 2, 2, 3).astype(np.float32)
+    layer = nn.BatchNorm(sync=True)
+    params, state = layer.init(KEY, jnp.asarray(x))
+
+    def per_shard(p, s, xs):
+        y, ns = layer.apply(p, s, xs, ctx_train(axis_name="data"))
+        return y, ns
+
+    y_sync, st_sync = jax.jit(
+        jax.shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(P(), P(), P("data")),
+            out_specs=(P("data"), P()),
+            check_vma=False,
+        )
+    )(params, state, jnp.asarray(x))
+
+    layer_local = nn.BatchNorm()
+    y_full, st_full = layer_local.apply(params, state, jnp.asarray(x), ctx_train())
+    np.testing.assert_allclose(np.asarray(y_sync), np.asarray(y_full), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(st_sync["mean"]), np.asarray(st_full["mean"]), rtol=1e-4, atol=1e-6)
+    # unbiased-var correction uses the GLOBAL count when synced
+    np.testing.assert_allclose(np.asarray(st_sync["var"]), np.asarray(st_full["var"]), rtol=1e-4, atol=1e-6)
+
+
+def test_convert_sync_batchnorm_walks_tree():
+    model = nn.Sequential(
+        nn.Conv2d(4, 3, padding=1),
+        nn.BatchNorm(),
+        nn.Sequential(nn.BatchNorm(), nn.ReLU()),
+    )
+    nn.convert_sync_batchnorm(model)
+    assert model[1].sync is True
+    assert model[2][0].sync is True
+
+
+def test_cross_entropy_matches_torch():
+    logits = np.random.RandomState(5).randn(10, 7).astype(np.float32)
+    labels = np.random.RandomState(6).randint(0, 7, 10)
+    ours = nn.cross_entropy(jnp.asarray(logits), jnp.asarray(labels))
+    ref = F.cross_entropy(torch.from_numpy(logits), torch.from_numpy(labels)).item()
+    assert float(ours) == pytest.approx(ref, rel=1e-5)
+    ours_sum = nn.cross_entropy(jnp.asarray(logits), jnp.asarray(labels), "sum")
+    assert float(ours_sum) == pytest.approx(ref * 10, rel=1e-5)
+
+
+def test_cross_entropy_weighted_mask_ignores_padding():
+    logits = np.random.RandomState(7).randn(6, 3).astype(np.float32)
+    labels = np.array([0, 1, 2, 0, 1, 2])
+    w = jnp.array([1, 1, 1, 1, 0, 0], jnp.float32)
+    masked = nn.cross_entropy(jnp.asarray(logits), jnp.asarray(labels), "mean", w)
+    unpadded = nn.cross_entropy(jnp.asarray(logits[:4]), jnp.asarray(labels[:4]))
+    assert float(masked) == pytest.approx(float(unpadded), rel=1e-6)
+
+
+def test_sequential_threads_state_and_shapes():
+    x = jnp.ones((2, 8, 8, 3))
+    model = nn.Sequential(
+        nn.Conv2d(4, 3, padding=1),
+        nn.BatchNorm(),
+        nn.ReLU(),
+        nn.MaxPool2d(2),
+        nn.Flatten(),
+        nn.Linear(10),
+    )
+    params, state = model.init(KEY, x)
+    y, new_state = model.apply(params, state, x, ctx_train())
+    assert y.shape == (2, 10)
+    assert len(new_state) == 6
+    # BN state updated in train mode
+    assert not np.allclose(np.asarray(new_state[1]["mean"]), 0.0)

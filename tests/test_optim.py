@@ -1,0 +1,79 @@
+"""Optimizer parity vs torch (reference uses Adam lr=1e-3,
+multi-GPU-training-torch.py:249)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tpuddp import optim
+
+
+def torch_steps(opt_cls, kwargs, w0, grads_seq):
+    w = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+    opt = opt_cls([w], **kwargs)
+    for g in grads_seq:
+        opt.zero_grad()
+        w.grad = torch.from_numpy(g.copy())
+        opt.step()
+    return w.detach().numpy()
+
+
+def ours_steps(opt, w0, grads_seq):
+    params = {"w": jnp.asarray(w0)}
+    state = opt.init(params)
+    for g in grads_seq:
+        params, state = opt.update({"w": jnp.asarray(g)}, state, params)
+    return np.asarray(params["w"])
+
+
+W0 = np.random.RandomState(0).randn(7, 3).astype(np.float32)
+GRADS = [np.random.RandomState(i + 1).randn(7, 3).astype(np.float32) for i in range(5)]
+
+
+def test_adam_matches_torch():
+    ref = torch_steps(torch.optim.Adam, dict(lr=1e-3), W0, GRADS)
+    got = ours_steps(optim.Adam(lr=1e-3), W0, GRADS)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_weight_decay_matches_torch():
+    ref = torch_steps(torch.optim.Adam, dict(lr=1e-2, weight_decay=0.1), W0, GRADS)
+    got = ours_steps(optim.Adam(lr=1e-2, weight_decay=0.1), W0, GRADS)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_plain_matches_torch():
+    ref = torch_steps(torch.optim.SGD, dict(lr=0.1), W0, GRADS)
+    got = ours_steps(optim.SGD(lr=0.1), W0, GRADS)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_sgd_momentum_nesterov_matches_torch():
+    for nesterov in (False, True):
+        ref = torch_steps(
+            torch.optim.SGD, dict(lr=0.1, momentum=0.9, nesterov=nesterov), W0, GRADS
+        )
+        got = ours_steps(optim.SGD(lr=0.1, momentum=0.9, nesterov=nesterov), W0, GRADS)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_update_is_jittable_and_state_is_pytree():
+    opt = optim.Adam(1e-3)
+    params = {"w": jnp.ones((3,))}
+    state = opt.init(params)
+    jitted = jax.jit(opt.update)
+    p2, s2 = jitted({"w": jnp.ones((3,))}, state, params)
+    assert int(s2.step) == 1
+    jax.tree_util.tree_map(lambda x: x, s2)  # must be a valid pytree
+
+
+def test_clip_grad_norm():
+    grads = {"a": jnp.ones((4,)) * 3.0}  # norm 6
+    clipped, norm = optim.clip_grad_norm_(grads, 3.0)
+    assert float(norm) == pytest.approx(6.0)
+    assert float(optim.global_norm(clipped)) == pytest.approx(3.0, rel=1e-4)
+    # no-op when under the limit
+    clipped2, _ = optim.clip_grad_norm_(grads, 100.0)
+    np.testing.assert_allclose(np.asarray(clipped2["a"]), 3.0)

@@ -1,0 +1,39 @@
+"""tpuddp.nn — a compact functional neural-net layer library.
+
+Pure init/apply modules over explicit parameter pytrees (no framework
+dependency): the compute path is jax.numpy + lax so everything fuses under jit
+and tiles onto the TPU MXU. Layout is NHWC (TPU-native), vs the reference
+stack's NCHW.
+"""
+
+from tpuddp.nn.core import Context, Module, Sequential  # noqa: F401
+from tpuddp.nn.layers import (  # noqa: F401
+    AdaptiveAvgPool2d,
+    AvgPool2d,
+    Conv2d,
+    Dropout,
+    Flatten,
+    Linear,
+    MaxPool2d,
+    ReLU,
+)
+from tpuddp.nn.norm import BatchNorm, convert_sync_batchnorm  # noqa: F401
+from tpuddp.nn.loss import CrossEntropyLoss, cross_entropy  # noqa: F401
+
+__all__ = [
+    "Context",
+    "Module",
+    "Sequential",
+    "Linear",
+    "Conv2d",
+    "MaxPool2d",
+    "AvgPool2d",
+    "AdaptiveAvgPool2d",
+    "ReLU",
+    "Dropout",
+    "Flatten",
+    "BatchNorm",
+    "convert_sync_batchnorm",
+    "CrossEntropyLoss",
+    "cross_entropy",
+]

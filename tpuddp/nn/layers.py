@@ -1,0 +1,214 @@
+"""Standard layers. NHWC layout; weights HWIO (the lax.conv native layout on
+TPU, so XLA tiles convs straight onto the MXU without transposes).
+
+Initialization follows the same fan-in uniform scheme the reference's model
+zoo inherits from torch (U(-1/sqrt(fan_in), 1/sqrt(fan_in)) for Linear/Conv),
+so loss curves are comparable at matched seeds-in-distribution.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuddp.nn.core import Context, Module
+
+IntOr2 = Union[int, Tuple[int, int]]
+
+
+def _pair(v: IntOr2) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class Linear(Module):
+    """y = x @ W + b, W: (in, out). ``in_features`` is inferred at init."""
+
+    def __init__(self, out_features: int, use_bias: bool = True, dtype=jnp.float32):
+        self.out_features = out_features
+        self.use_bias = use_bias
+        self.dtype = dtype
+
+    def init(self, key, x):
+        in_features = x.shape[-1]
+        bound = 1.0 / math.sqrt(in_features)
+        wk, bk = jax.random.split(key)
+        params = {
+            "weight": jax.random.uniform(
+                wk, (in_features, self.out_features), self.dtype, -bound, bound
+            )
+        }
+        if self.use_bias:
+            params["bias"] = jax.random.uniform(
+                bk, (self.out_features,), self.dtype, -bound, bound
+            )
+        return params, ()
+
+    def apply(self, params, state, x, ctx: Context):
+        y = x @ params["weight"]
+        if self.use_bias:
+            y = y + params["bias"]
+        return y, state
+
+
+class Conv2d(Module):
+    """2-D convolution, NHWC / HWIO. ``padding`` is 'SAME', 'VALID', or an int
+    (symmetric, torch-style)."""
+
+    def __init__(
+        self,
+        features: int,
+        kernel_size: IntOr2,
+        strides: IntOr2 = 1,
+        padding: Union[str, int, Sequence[Tuple[int, int]]] = 0,
+        use_bias: bool = True,
+        dtype=jnp.float32,
+    ):
+        self.features = features
+        self.kernel_size = _pair(kernel_size)
+        self.strides = _pair(strides)
+        self.padding = padding
+        self.use_bias = use_bias
+        self.dtype = dtype
+
+    def _pad_arg(self):
+        if isinstance(self.padding, str):
+            return self.padding
+        if isinstance(self.padding, int):
+            p = self.padding
+            return [(p, p), (p, p)]
+        return list(self.padding)
+
+    def init(self, key, x):
+        in_ch = x.shape[-1]
+        kh, kw = self.kernel_size
+        fan_in = in_ch * kh * kw
+        bound = 1.0 / math.sqrt(fan_in)
+        wk, bk = jax.random.split(key)
+        params = {
+            "weight": jax.random.uniform(
+                wk, (kh, kw, in_ch, self.features), self.dtype, -bound, bound
+            )
+        }
+        if self.use_bias:
+            params["bias"] = jax.random.uniform(
+                bk, (self.features,), self.dtype, -bound, bound
+            )
+        return params, ()
+
+    def apply(self, params, state, x, ctx: Context):
+        y = lax.conv_general_dilated(
+            x,
+            params["weight"].astype(x.dtype),
+            window_strides=self.strides,
+            padding=self._pad_arg(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+
+class _Pool2d(Module):
+    def __init__(self, window: IntOr2, strides: Optional[IntOr2] = None, padding: Union[str, int] = 0):
+        self.window = _pair(window)
+        self.strides = _pair(strides) if strides is not None else self.window
+        self.padding = padding
+
+    def _pad_arg(self):
+        if isinstance(self.padding, str):
+            return self.padding
+        p = self.padding
+        return [(0, 0), (p, p), (p, p), (0, 0)]
+
+
+class MaxPool2d(_Pool2d):
+    def apply(self, params, state, x, ctx: Context):
+        init_val = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        y = lax.reduce_window(
+            x,
+            init_val,
+            lax.max,
+            (1, *self.window, 1),
+            (1, *self.strides, 1),
+            self._pad_arg(),
+        )
+        return y, state
+
+
+class AvgPool2d(_Pool2d):
+    def apply(self, params, state, x, ctx: Context):
+        wh, ww = self.window
+        y = lax.reduce_window(
+            x, 0.0, lax.add, (1, wh, ww, 1), (1, *self.strides, 1), self._pad_arg()
+        )
+        return y / (wh * ww), state
+
+
+class AdaptiveAvgPool2d(Module):
+    """torch-style adaptive average pooling to a fixed (H_out, W_out).
+
+    Bin i covers [floor(i*N/M), ceil((i+1)*N/M)) — bins may be non-uniform, so
+    this is computed from a 2-D integral image (cumsum) with *static* gather
+    indices: four corner lookups + area divide. Fully shape-static, so XLA
+    fuses it; no dynamic control flow.
+    """
+
+    def __init__(self, output_size: IntOr2):
+        self.output_size = _pair(output_size)
+
+    @staticmethod
+    def _bounds(n_in: int, n_out: int):
+        starts = [(i * n_in) // n_out for i in range(n_out)]
+        ends = [-(-((i + 1) * n_in) // n_out) for i in range(n_out)]  # ceil div
+        return jnp.array(starts), jnp.array(ends)
+
+    def apply(self, params, state, x, ctx: Context):
+        n, h, w, c = x.shape
+        oh, ow = self.output_size
+        # integral image with a leading zero row/col: I[i, j] = sum(x[:i, :j])
+        ii = jnp.cumsum(jnp.cumsum(x, axis=1), axis=2)
+        ii = jnp.pad(ii, ((0, 0), (1, 0), (1, 0), (0, 0)))
+        hs, he = self._bounds(h, oh)
+        ws, we = self._bounds(w, ow)
+        # window sum via 4 corners, broadcast over output grid
+        a = ii[:, he[:, None], we[None, :], :]
+        b = ii[:, he[:, None], ws[None, :], :]
+        c_ = ii[:, hs[:, None], we[None, :], :]
+        d = ii[:, hs[:, None], ws[None, :], :]
+        sums = a - b - c_ + d
+        areas = ((he - hs)[:, None] * (we - ws)[None, :]).astype(x.dtype)
+        return sums / areas[None, :, :, None], state
+
+
+class ReLU(Module):
+    def apply(self, params, state, x, ctx: Context):
+        return jax.nn.relu(x), state
+
+
+class Flatten(Module):
+    def apply(self, params, state, x, ctx: Context):
+        return x.reshape(x.shape[0], -1), state
+
+
+class Dropout(Module):
+    """Inverted dropout; active only when ``ctx.train`` and ``ctx.rng`` given.
+    Per-replica masks come from the step fn folding ``lax.axis_index`` into the
+    key (tpuddp.seeding.fold_in_axis_index)."""
+
+    def __init__(self, p: float = 0.5):
+        if not 0.0 <= p < 1.0:
+            raise ValueError(f"dropout p must be in [0, 1), got {p}")
+        self.p = p
+
+    def apply(self, params, state, x, ctx: Context):
+        if not ctx.train or self.p == 0.0:
+            return x, state
+        if ctx.rng is None:
+            raise ValueError("Dropout in train mode requires ctx.rng")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(ctx.rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), state

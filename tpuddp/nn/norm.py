@@ -1,0 +1,110 @@
+"""BatchNorm with optional cross-replica statistic synchronization.
+
+This owns the SyncBatchNorm contract the reference documents but does not code
+(README.md:79-81; SURVEY.md §2b #16): under data parallelism, per-device batch
+statistics are biased toward the local shard, so ``sync=True`` computes the
+batch mean / mean-of-squares with ``lax.pmean`` over the ``"data"`` mesh axis
+before normalizing — every replica then normalizes with *global*-batch
+statistics, exactly what ``torch.nn.SyncBatchNorm`` does with its CUDA kernels,
+here as two fused XLA collectives over ICI.
+
+torch-parity details kept: momentum 0.1 (new-stat weight), eps 1e-5, biased
+variance for normalization but **unbiased** variance for the running buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from tpuddp.nn.core import Context, Module, Sequential
+
+
+class BatchNorm(Module):
+    """Batch normalization over all axes except the last (features).
+
+    ``sync``: if True, batch statistics are averaged across the data-parallel
+    axis (``ctx.axis_name``) — the SyncBatchNorm behavior. If False (default,
+    matching plain ``nn.BatchNorm2d``), statistics are local to the replica.
+    """
+
+    def __init__(
+        self,
+        momentum: float = 0.1,
+        eps: float = 1e-5,
+        affine: bool = True,
+        track_running_stats: bool = True,
+        sync: bool = False,
+        dtype=jnp.float32,
+    ):
+        self.momentum = momentum
+        self.eps = eps
+        self.affine = affine
+        self.track_running_stats = track_running_stats
+        self.sync = sync
+        self.dtype = dtype
+
+    def init(self, key, x):
+        features = x.shape[-1]
+        params = (
+            {
+                "scale": jnp.ones((features,), self.dtype),
+                "bias": jnp.zeros((features,), self.dtype),
+            }
+            if self.affine
+            else {}
+        )
+        state = (
+            {
+                "mean": jnp.zeros((features,), self.dtype),
+                "var": jnp.ones((features,), self.dtype),
+            }
+            if self.track_running_stats
+            else {}
+        )
+        return params, state
+
+    def apply(self, params, state, x, ctx: Context):
+        reduce_axes = tuple(range(x.ndim - 1))
+        use_batch_stats = ctx.train or not self.track_running_stats
+
+        if use_batch_stats:
+            mean = jnp.mean(x, axis=reduce_axes)
+            mean_sq = jnp.mean(jnp.square(x), axis=reduce_axes)
+            n = x.size // x.shape[-1]
+            if self.sync and ctx.axis_name is not None:
+                mean = lax.pmean(mean, ctx.axis_name)
+                mean_sq = lax.pmean(mean_sq, ctx.axis_name)
+                n = n * lax.axis_size(ctx.axis_name)
+            var = mean_sq - jnp.square(mean)  # biased, used for normalization
+            new_state = state
+            if self.track_running_stats and ctx.train:
+                m = self.momentum
+                unbiased = var * (n / max(n - 1, 1))
+                new_state = {
+                    "mean": (1 - m) * state["mean"] + m * mean,
+                    "var": (1 - m) * state["var"] + m * unbiased,
+                }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+
+        inv = lax.rsqrt(var + self.eps)
+        y = (x - mean) * inv
+        if self.affine:
+            y = y * params["scale"] + params["bias"]
+        return y.astype(x.dtype), new_state
+
+
+def convert_sync_batchnorm(module: Module) -> Module:
+    """Flip every BatchNorm in a module tree to ``sync=True`` — API parity with
+    ``torch.nn.SyncBatchNorm.convert_sync_batchnorm`` (reference README.md:79-81).
+    Mutates hyperparameters in place (parameters/state are unaffected) and
+    returns the module for chaining."""
+    if isinstance(module, BatchNorm):
+        module.sync = True
+    for child in module.children():
+        convert_sync_batchnorm(child)
+    return module
