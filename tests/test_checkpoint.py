@@ -1,0 +1,80 @@
+"""Checkpoint/resume (SURVEY.md §2b #18): rank-0 naming parity, atomic save,
+typed-PRNG-key round-trip, resume helper the reference lacks."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuddp import optim
+from tpuddp.models import ToyMLP
+from tpuddp.training import checkpoint as ckpt
+from tpuddp.training.train_state import create_train_state
+
+
+def make_state():
+    model = ToyMLP(hidden=(8,))
+    return model, create_train_state(
+        model, optim.Adam(1e-3), jax.random.key(0), jnp.zeros((1, 4, 4, 3))
+    )
+
+
+def assert_tree_equal(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)), a, b
+    )
+
+
+def test_round_trip_train_state(tmp_path):
+    _, state = make_state()
+    path = ckpt.save(str(tmp_path / "s.npz"), state)
+    restored = ckpt.load(path, state)
+    assert_tree_equal(restored.params, state.params)
+    assert_tree_equal(restored.opt_state, state.opt_state)
+    # typed PRNG key survives
+    assert jnp.array_equal(
+        jax.random.key_data(restored.rng), jax.random.key_data(state.rng)
+    )
+
+
+def test_save_on_main_naming_and_barrier(tmp_path):
+    _, state = make_state()
+    path = ckpt.save_on_main(str(tmp_path), epoch=5, tree=state)
+    assert os.path.basename(path) == "ckpt_5.npz"  # reference naming parity
+    assert os.path.exists(path)
+
+
+def test_latest_and_restore(tmp_path):
+    _, state = make_state()
+    for e in (0, 5, 10):
+        ckpt.save_on_main(str(tmp_path), e, state)
+    path, epoch = ckpt.latest(str(tmp_path))
+    assert epoch == 10 and path.endswith("ckpt_10.npz")
+    restored, next_epoch = ckpt.restore_latest(str(tmp_path), state)
+    assert next_epoch == 11
+    assert_tree_equal(restored.params, state.params)
+
+
+def test_restore_latest_empty_dir(tmp_path):
+    _, state = make_state()
+    restored, next_epoch = ckpt.restore_latest(str(tmp_path / "nope"), state)
+    assert next_epoch == 0
+    assert restored is state
+
+
+def test_missing_leaf_raises(tmp_path):
+    _, state = make_state()
+    path = ckpt.save(str(tmp_path / "s.npz"), {"params": state.params})
+    try:
+        ckpt.load(path, state)
+    except KeyError as e:
+        assert "missing leaf" in str(e)
+    else:
+        raise AssertionError("expected KeyError")
+
+
+def test_no_tmp_file_left_behind(tmp_path):
+    _, state = make_state()
+    ckpt.save(str(tmp_path / "s.npz"), state)
+    assert os.listdir(tmp_path) == ["s.npz"]

@@ -1,0 +1,143 @@
+"""The go/no-go gate (SURVEY.md §7 step 3): 8-way DP loss curves match the
+single-device run — the BASELINE.json north-star metric ("loss-curve parity"),
+plus DDP gradient semantics and SyncBN-under-DP exactness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuddp import optim
+from tpuddp.data import ShardedDataLoader, SyntheticClassification
+from tpuddp.models import ToyCNN, ToyMLP
+from tpuddp.nn import CrossEntropyLoss
+from tpuddp.parallel import make_mesh
+from tpuddp.parallel.ddp import DistributedDataParallel
+from tpuddp.training.loop import run_training_loop
+from tpuddp.training.step import accumulate_metrics, finalize_metrics
+
+KEY = jax.random.key(42)
+
+
+def run_config(model_fn, mesh, n_epochs=2, mode="shard_map", n=128, batch=4, lr=1e-2):
+    """Train on the mesh; per-replica batch keeps GLOBAL batch fixed at 32."""
+    world = mesh.devices.size
+    per_replica = (batch * 8) // world
+    ds = SyntheticClassification(n=n, shape=(8, 8, 3), seed=7)
+    loader = ShardedDataLoader(ds, per_replica, mesh, shuffle=False)
+    test_loader = ShardedDataLoader(ds, per_replica, mesh, shuffle=False)
+    model = model_fn()
+    ddp = DistributedDataParallel(
+        model, optim.Adam(lr), CrossEntropyLoss(), mesh=mesh, mode=mode
+    )
+    state = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    state, history = run_training_loop(
+        ddp, state, loader, test_loader, save_dir=None, num_epochs=n_epochs,
+        set_epoch=False, log=lambda *_: None,
+    )
+    return history
+
+
+@pytest.mark.parametrize("mode", ["shard_map", "auto"])
+def test_dp8_matches_single_device_losses(cpu_devices, mode):
+    """Same data, same init, same global batch: the 8-way DP loss curve must
+    equal the 1-device curve (DDP grad-averaging is exactly the global-batch
+    gradient when shards are equal)."""
+    h1 = run_config(ToyMLP, make_mesh(cpu_devices[:1]), mode=mode)
+    h8 = run_config(ToyMLP, make_mesh(cpu_devices), mode=mode)
+    for a, b in zip(h1, h8):
+        assert a["train_loss"] == pytest.approx(b["train_loss"], rel=2e-4)
+        assert a["test_loss"] == pytest.approx(b["test_loss"], rel=2e-4)
+        assert a["train_samples"] == b["train_samples"]
+
+
+def test_shard_map_and_auto_modes_agree(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    ha = run_config(ToyMLP, mesh, mode="shard_map")
+    hb = run_config(ToyMLP, mesh, mode="auto")
+    for a, b in zip(ha, hb):
+        assert a["train_loss"] == pytest.approx(b["train_loss"], rel=2e-4)
+
+
+def test_sync_bn_dp_matches_single_device(cpu_devices):
+    """SyncBatchNorm contract end-to-end: a BN model under 8-way DP with
+    synced stats reproduces the single-device (global-batch-stats) run."""
+    h1 = run_config(lambda: ToyCNN(sync_bn=True), make_mesh(cpu_devices[:1]))
+    h8 = run_config(lambda: ToyCNN(sync_bn=True), make_mesh(cpu_devices))
+    for a, b in zip(h1, h8):
+        assert a["train_loss"] == pytest.approx(b["train_loss"], rel=5e-4)
+        assert a["test_loss"] == pytest.approx(b["test_loss"], rel=5e-4)
+
+
+def test_loss_decreases_on_learnable_data(cpu_devices):
+    history = run_config(ToyMLP, make_mesh(cpu_devices), n_epochs=4)
+    assert history[-1]["train_loss"] < history[0]["train_loss"] * 0.5
+    assert history[-1]["test_accuracy"] > 80.0
+
+
+def test_ddp_grads_equal_mean_of_shard_grads(cpu_devices):
+    """Direct DDP-semantics check (SURVEY.md §4 parity tests): one DP step
+    must move params exactly as the mean of per-shard gradients would."""
+    mesh = make_mesh(cpu_devices)
+    model = ToyMLP(hidden=(16,))
+    opt = optim.SGD(lr=0.1)
+    criterion = CrossEntropyLoss()
+    ddp = DistributedDataParallel(model, opt, criterion, mesh=mesh)
+    x = np.random.RandomState(0).randn(16, 8, 8, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 16)
+    w = np.ones(16, np.float32)
+
+    state = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    params0 = jax.tree_util.tree_map(np.asarray, state.params)
+    batch = ddp.shard((x, y, w))
+    new_state, _ = ddp.train_step(state, batch)
+
+    # oracle: mean over 8 per-shard gradients of the per-shard mean loss
+    from tpuddp.nn.core import Context
+
+    mstate = state.model_state
+
+    def shard_loss(params, xs, ys):
+        logits, _ = model.apply(params, mstate, xs, Context(train=True))
+        return criterion(logits, ys)
+
+    grad_fn = jax.grad(shard_loss)
+    shard_grads = [
+        grad_fn(
+            jax.tree_util.tree_map(jnp.asarray, params0),
+            jnp.asarray(x[i * 2 : (i + 1) * 2]),
+            jnp.asarray(y[i * 2 : (i + 1) * 2]),
+        )
+        for i in range(8)
+    ]
+    mean_grads = jax.tree_util.tree_map(
+        lambda *gs: sum(gs) / len(gs), *shard_grads
+    )
+    expected = jax.tree_util.tree_map(
+        lambda p, g: p - 0.1 * np.asarray(g), params0, mean_grads
+    )
+    got = jax.tree_util.tree_map(np.asarray, new_state.params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        got,
+        expected,
+    )
+
+
+def test_masked_final_batch_metrics_are_exact(cpu_devices):
+    """Padded final batches (static shapes) must not distort sample-weighted
+    metrics: n == real dataset size (+ sampler wrap-pads), never the padded size."""
+    mesh = make_mesh(cpu_devices[:4])
+    ds = SyntheticClassification(n=50, shape=(8, 8, 3), seed=3)
+    loader = ShardedDataLoader(ds, batch_size=8, mesh=mesh, shuffle=False)
+    model = ToyMLP(hidden=(16,))
+    ddp = DistributedDataParallel(model, optim.SGD(0.01), CrossEntropyLoss(), mesh=mesh)
+    state = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    acc = None
+    for host_batch in loader:
+        m = ddp.eval_step(state, ddp.shard(host_batch))
+        acc = accumulate_metrics(acc, m)
+    final = finalize_metrics(acc)
+    # 50 samples over 4 replicas -> 13 each = 52 weighted samples (2 wrap-pads)
+    assert final["n"] == 52.0
+    assert 0 <= final["correct"] <= 52

@@ -1,0 +1,6 @@
+"""Data layer: datasets, host loaders, and device-side transforms."""
+
+from tpuddp.data.loader import DataLoader, ShardedDataLoader  # noqa: F401
+from tpuddp.data.synthetic import SyntheticClassification  # noqa: F401
+
+__all__ = ["DataLoader", "ShardedDataLoader", "SyntheticClassification"]

@@ -1,0 +1,190 @@
+"""Host-side batch loaders.
+
+Two loaders mirror the two dataloading shapes in the reference:
+
+- :class:`DataLoader` — a plain single-stream loader (the accelerate
+  entrypoint's unsharded loaders, multi-GPU-training-accelerate.py:22-36, and
+  its deliberately-unprepared test loader, :129-131 / quirk Q3);
+- :class:`ShardedDataLoader` — the DP loader. The reference gives each of N
+  single-GPU processes its own ``DataLoader(sampler=DistributedSampler(...))``
+  (multi-GPU-training-torch.py:72-101). On TPU one process drives many chips,
+  so this loader runs one :class:`DistributedSampler` per *local replica* and
+  assembles their microbatches, in mesh order, into the process-local slice of
+  the global batch; ``tpuddp.parallel.mesh.shard_batch`` then places it on the
+  mesh (multi-host: every process loads ONLY its shard — the global
+  permutation stays consistent because every sampler keys off the same
+  seed+epoch).
+
+TPU-first batching: every batch has a static shape. Final partial batches are
+padded and carry a 0/1 weight vector ``w`` (consumed by the masked loss /
+metric math) instead of producing a ragged last batch that would retrigger XLA
+compilation.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from tpuddp.parallel.sampler import DistributedSampler
+
+
+def _fetch(dataset, indices: np.ndarray):
+    """Vectorized batch fetch when the dataset supports it."""
+    if hasattr(dataset, "get_batch"):
+        return dataset.get_batch(indices)
+    xs, ys = zip(*(dataset[int(i)] for i in indices))
+    return np.stack(xs), np.asarray(ys)
+
+
+def _pad_batch(x: np.ndarray, y: np.ndarray, batch_size: int):
+    """Pad to the static batch size; w marks real samples."""
+    n = len(y)
+    w = np.ones(batch_size, np.float32)
+    if n < batch_size:
+        pad = batch_size - n
+        x = np.concatenate([x, np.repeat(x[:1], pad, axis=0)])
+        y = np.concatenate([y, np.zeros(pad, y.dtype)])
+        w[n:] = 0.0
+    return x, y, w
+
+
+class DataLoader:
+    """Single-stream host loader yielding ``(x, y, w)`` numpy batches.
+
+    ``sampler``: optional index source with the DistributedSampler protocol
+    (iter + set_epoch). Without one, iterates sequentially or shuffled
+    (``shuffle=True``, reshuffled per epoch via ``set_epoch`` like the
+    sampler-based path).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        sampler: Optional[DistributedSampler] = None,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.sampler = sampler
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        if self.sampler is not None:
+            self.sampler.set_epoch(epoch)
+
+    def _indices(self) -> np.ndarray:
+        if self.sampler is not None:
+            return np.fromiter(iter(self.sampler), dtype=np.int64)
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.Generator(np.random.PCG64(self.seed + self.epoch))
+            return rng.permutation(n)
+        return np.arange(n)
+
+    def __len__(self) -> int:
+        n = len(self.sampler) if self.sampler is not None else len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return math.ceil(n / self.batch_size)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        indices = self._indices()
+        steps = len(self)
+        for s in range(steps):
+            chunk = indices[s * self.batch_size : (s + 1) * self.batch_size]
+            x, y = _fetch(self.dataset, chunk)
+            yield _pad_batch(x, y, self.batch_size)
+
+
+class ShardedDataLoader:
+    """Global-batch DP loader: one instance per process, one sampler per local
+    replica. Yields the process-local ``(x, y, w)`` slice of the global batch
+    (concat over local replicas in mesh order); pair with
+    ``DistributedDataParallel.shard`` / ``mesh.shard_batch`` for placement.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        mesh,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size  # per replica
+        self.mesh = mesh
+        self.drop_last = drop_last
+
+        flat_devices = list(mesh.devices.flat)
+        self.world_size = len(flat_devices)
+        proc = jax.process_index()
+        # global ranks of this process's replicas, in mesh traversal order —
+        # must match how NamedSharding lays the global batch across devices.
+        self.local_ranks = [
+            rank for rank, d in enumerate(flat_devices) if d.process_index == proc
+        ]
+        self.samplers = [
+            DistributedSampler(
+                len(dataset),
+                num_replicas=self.world_size,
+                rank=rank,
+                shuffle=shuffle,
+                seed=seed,
+            )
+            for rank in self.local_ranks
+        ]
+
+    def set_epoch(self, epoch: int) -> None:
+        """Fan set_epoch to every local replica's sampler (reference
+        multi-GPU-training-torch.py:175-178)."""
+        for s in self.samplers:
+            s.set_epoch(epoch)
+
+    @property
+    def num_samples_per_replica(self) -> int:
+        return self.samplers[0].num_samples
+
+    def __len__(self) -> int:
+        n = self.num_samples_per_replica
+        if self.drop_last:
+            return n // self.batch_size
+        return math.ceil(n / self.batch_size)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        per_replica = [s.local_indices() for s in self.samplers]
+        steps = len(self)
+        for s in range(steps):
+            xs, ys, ws = [], [], []
+            for shard in per_replica:
+                chunk = shard[s * self.batch_size : (s + 1) * self.batch_size]
+                x, y = _fetch(self.dataset, chunk)
+                x, y, w = _pad_batch(x, y, self.batch_size)
+                xs.append(x)
+                ys.append(y)
+                ws.append(w)
+            yield np.concatenate(xs), np.concatenate(ys), np.concatenate(ws)
+
+    def probe_fingerprint(self, x_local: np.ndarray) -> str:
+        """Shard-disjointness probe string: a few raw input values per local
+        replica (the reference's manual multi-GPU-training-torch.py:112-115
+        probe, adapted to NHWC and any input size)."""
+        parts = []
+        for i, rank in enumerate(self.local_ranks):
+            sample = x_local[i * self.batch_size]
+            flat = np.asarray(sample).reshape(-1)
+            mid = flat.size // 2
+            parts.append(f"replica {rank}: {np.array2string(flat[mid : mid + 4], precision=4)}")
+        return "; ".join(parts)

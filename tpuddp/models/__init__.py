@@ -1,0 +1,27 @@
+"""Model zoo. The reference's zoo is ``load_model`` = pretrained AlexNet with
+its classifier head swapped for CIFAR-10 (data_and_toy_model.py:41-45); tpuddp
+adds genuinely small toy models for fast CI (per SURVEY.md scale calibration)
+and a ResNet-18 for the multi-host BASELINE config."""
+
+from tpuddp.models.toy import ToyCNN, ToyMLP  # noqa: F401
+from tpuddp.models.alexnet import AlexNet  # noqa: F401
+from tpuddp.models.resnet import ResNet18  # noqa: F401
+
+_REGISTRY = {
+    "toy_mlp": ToyMLP,
+    "toy_cnn": ToyCNN,
+    "alexnet": AlexNet,
+    "resnet18": ResNet18,
+}
+
+
+def load_model(name: str = "alexnet", num_classes: int = 10, **kwargs):
+    """Registry-based analog of the reference's ``load_model()``."""
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown model {name!r}; one of {sorted(_REGISTRY)}")
+    return cls(num_classes=num_classes, **kwargs)
+
+
+__all__ = ["ToyMLP", "ToyCNN", "AlexNet", "ResNet18", "load_model"]

@@ -1,0 +1,89 @@
+"""ResNet-18 (NHWC) — for the multi-host CIFAR BASELINE config
+(BASELINE.json configs[4]). BatchNorm layers honor convert_sync_batchnorm /
+``sync_bn=True`` so cross-replica statistic sync works under DP."""
+
+from __future__ import annotations
+
+import jax
+
+from tpuddp import nn
+from tpuddp.nn.core import Context, Module
+
+
+class BasicBlock(Module):
+    """Two 3x3 convs with identity (or 1x1-projected) shortcut."""
+
+    def __init__(self, features: int, stride: int = 1, sync_bn: bool = False):
+        self.features = features
+        self.stride = stride
+        self.conv1 = nn.Conv2d(features, 3, strides=stride, padding=1, use_bias=False)
+        self.bn1 = nn.BatchNorm(sync=sync_bn)
+        self.conv2 = nn.Conv2d(features, 3, padding=1, use_bias=False)
+        self.bn2 = nn.BatchNorm(sync=sync_bn)
+        self.down_conv = nn.Conv2d(features, 1, strides=stride, use_bias=False)
+        self.down_bn = nn.BatchNorm(sync=sync_bn)
+
+    def children(self):
+        return (self.conv1, self.bn1, self.conv2, self.bn2, self.down_conv, self.down_bn)
+
+    def init(self, key, x):
+        keys = jax.random.split(key, 6)
+        in_ch = x.shape[-1]
+        p, s = {}, {}
+        p["conv1"], _, h = self.conv1.init_with_output_shape(keys[0], x)
+        p["bn1"], s["bn1"], h = self.bn1.init_with_output_shape(keys[1], h)
+        p["conv2"], _, h = self.conv2.init_with_output_shape(keys[2], h)
+        p["bn2"], s["bn2"], _ = self.bn2.init_with_output_shape(keys[3], h)
+        if self.stride != 1 or in_ch != self.features:
+            p["down_conv"], _, d = self.down_conv.init_with_output_shape(keys[4], x)
+            p["down_bn"], s["down_bn"], _ = self.down_bn.init_with_output_shape(keys[5], d)
+        return p, s
+
+    def apply(self, params, state, x, ctx: Context):
+        new_state = dict(state)
+        h, _ = self.conv1.apply(params["conv1"], (), x, ctx)
+        h, new_state["bn1"] = self.bn1.apply(params["bn1"], state["bn1"], h, ctx)
+        h, _ = nn.ReLU().apply((), (), h, ctx)
+        h, _ = self.conv2.apply(params["conv2"], (), h, ctx)
+        h, new_state["bn2"] = self.bn2.apply(params["bn2"], state["bn2"], h, ctx)
+        if "down_conv" in params:
+            sc, _ = self.down_conv.apply(params["down_conv"], (), x, ctx)
+            sc, new_state["down_bn"] = self.down_bn.apply(
+                params["down_bn"], state["down_bn"], sc, ctx
+            )
+        else:
+            sc = x
+        return jax.nn.relu(h + sc), new_state
+
+
+class GlobalAvgPool(Module):
+    def apply(self, params, state, x, ctx: Context):
+        return x.mean(axis=(1, 2)), state
+
+
+def ResNet18(
+    num_classes: int = 10, sync_bn: bool = False, small_input: bool = False
+) -> nn.Sequential:
+    """Standard ResNet-18: stem + [2,2,2,2] BasicBlocks at widths
+    [64,128,256,512] + global-avg-pool head. ``small_input=True`` uses the
+    CIFAR stem (3x3/1 conv, no maxpool) for native 32x32 training — the
+    TPU-friendly alternative to the reference's resize-everything-to-224."""
+    if small_input:
+        stem = [
+            nn.Conv2d(64, 3, strides=1, padding=1, use_bias=False),
+            nn.BatchNorm(sync=sync_bn),
+            nn.ReLU(),
+        ]
+    else:
+        stem = [
+            nn.Conv2d(64, 7, strides=2, padding=3, use_bias=False),
+            nn.BatchNorm(sync=sync_bn),
+            nn.ReLU(),
+            nn.MaxPool2d(3, strides=2, padding=1),
+        ]
+    blocks = []
+    for width, stride in [(64, 1), (128, 2), (256, 2), (512, 2)]:
+        blocks.append(BasicBlock(width, stride=stride, sync_bn=sync_bn))
+        blocks.append(BasicBlock(width, stride=1, sync_bn=sync_bn))
+    head = [GlobalAvgPool(), nn.Linear(num_classes)]
+    return nn.Sequential(*stem, *blocks, *head)

@@ -1,0 +1,38 @@
+"""Toy models for fast CI and the toy-MLP BASELINE configs.
+
+``BASELINE.json`` names a "toy MLP" and a "toy CNN with SyncBatchNorm"; the
+reference itself has no toy model (its ``load_model`` is AlexNet,
+data_and_toy_model.py:41-45), so these are the genuinely-small CI models
+SURVEY.md's scale calibration calls for.
+"""
+
+from __future__ import annotations
+
+from tpuddp import nn
+
+
+def ToyMLP(num_classes: int = 10, hidden=(256, 128)) -> nn.Sequential:
+    """Flatten -> [Linear -> ReLU]* -> Linear head. Input: any NHWC image."""
+    layers = [nn.Flatten()]
+    for h in hidden:
+        layers += [nn.Linear(h), nn.ReLU()]
+    layers.append(nn.Linear(num_classes))
+    return nn.Sequential(*layers)
+
+
+def ToyCNN(num_classes: int = 10, widths=(32, 64), sync_bn: bool = False) -> nn.Sequential:
+    """Conv -> BN -> ReLU -> MaxPool blocks + linear head. With
+    ``sync_bn=True`` (or convert_sync_batchnorm later), batch statistics are
+    pmean'd across the data axis — the SyncBatchNorm BASELINE config."""
+    layers = []
+    for w in widths:
+        layers += [
+            # no conv bias before BN: BN cancels shifts, so a bias's gradient is
+            # pure float noise, which Adam would amplify nondeterministically
+            nn.Conv2d(w, kernel_size=3, padding=1, use_bias=False),
+            nn.BatchNorm(sync=sync_bn),
+            nn.ReLU(),
+            nn.MaxPool2d(2),
+        ]
+    layers += [nn.Flatten(), nn.Linear(num_classes)]
+    return nn.Sequential(*layers)

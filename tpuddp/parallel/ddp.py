@@ -1,0 +1,106 @@
+"""DistributedDataParallel — the explicit DP wrapper.
+
+Owns the contract of ``torch.nn.parallel.DistributedDataParallel`` (SURVEY.md
+§2b #13), reimagined functionally: instead of hooking autograd, it *builds*
+the compiled train/eval step in which gradient pmean, buffer broadcast, and
+metric partial-sums are explicit. Wrapping = ``ddp = DistributedDataParallel(
+model, optimizer, criterion, mesh)`` + ``state = ddp.init_state(key, sample)``;
+the construction-time rank-0 parameter broadcast of torch DDP
+(multi-GPU-training-torch.py:245) is performed in ``init_state`` via
+``broadcast_one_to_all``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from tpuddp.nn.core import Context
+from tpuddp.nn.loss import CrossEntropyLoss
+from tpuddp.parallel import collectives as col
+from tpuddp.parallel.mesh import data_mesh, replicated, shard_batch
+from tpuddp.training import step as step_lib
+from tpuddp.training.train_state import TrainState, create_train_state
+
+
+class DistributedDataParallel:
+    """Builds and caches the compiled DP steps for (model, optimizer, criterion).
+
+    mode="shard_map" is the explicit-DDP analog (visible lax.pmean); mode="auto"
+    is the managed analog used by the Accelerator facade. Both run on the same
+    mesh/collectives backend.
+    """
+
+    def __init__(
+        self,
+        model,
+        optimizer,
+        criterion: Optional[Callable] = None,
+        mesh=None,
+        mode: str = "shard_map",
+        sync_buffers: str = "broadcast",
+        clip_grad_norm: Optional[float] = None,
+        augment: Optional[Callable] = None,
+        eval_transform: Optional[Callable] = None,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.criterion = criterion if criterion is not None else CrossEntropyLoss()
+        self.mesh = mesh if mesh is not None else data_mesh()
+        self.mode = mode
+        self.sync_buffers = sync_buffers
+        self.clip_grad_norm = clip_grad_norm
+        self.augment = augment
+        self.eval_transform = eval_transform
+        self._train_step = None
+        self._eval_step = None
+
+    # -- world introspection (dist.get_world_size analog) -------------------
+    @property
+    def world_size(self) -> int:
+        return self.mesh.devices.size
+
+    def init_state(self, key, sample_input) -> TrainState:
+        """Create replicated train state. Parameters are broadcast from
+        process 0 (multi-host) and placed replicated on every mesh device —
+        the DDP construction contract."""
+        state = create_train_state(self.model, self.optimizer, key, sample_input)
+        state = col.broadcast_one_to_all(state)
+        return jax.device_put(state, replicated(self.mesh))
+
+    def shard(self, batch):
+        """Place a host batch onto the mesh, split over the data axis."""
+        return shard_batch(self.mesh, batch)
+
+    def train_step(self, state: TrainState, batch):
+        if self._train_step is None:
+            self._train_step = step_lib.build_train_step(
+                self.model,
+                self.criterion,
+                self.optimizer,
+                self.mesh,
+                mode=self.mode,
+                sync_buffers=self.sync_buffers,
+                clip_grad_norm=self.clip_grad_norm,
+                augment=self.augment,
+            )
+        return self._train_step(state, batch)
+
+    def eval_step(self, state: TrainState, batch):
+        if self._eval_step is None:
+            self._eval_step = step_lib.build_eval_step(
+                self.model,
+                self.criterion,
+                self.mesh,
+                mode=self.mode,
+                transform=self.eval_transform,
+            )
+        return self._eval_step(state, batch)
+
+    def forward(self, state: TrainState, x):
+        """Inference forward (replicated params, sharded batch)."""
+        logits, _ = self.model.apply(
+            state.params, state.model_state, x, Context(train=False)
+        )
+        return logits

@@ -1,0 +1,7 @@
+"""Training layer: TrainState, compiled DP steps, epoch driver, checkpointing."""
+
+from tpuddp.training.train_state import TrainState, create_train_state  # noqa: F401
+from tpuddp.training.loop import run_training_loop  # noqa: F401
+from tpuddp.training import checkpoint  # noqa: F401
+
+__all__ = ["TrainState", "create_train_state", "run_training_loop", "checkpoint"]

@@ -1,0 +1,115 @@
+"""Checkpointing — save/restore of arbitrary pytrees with the reference's
+single-writer discipline, plus the resume path the reference lacks.
+
+Reference contract (multi-GPU-training-torch.py:217-223; SURVEY.md §2b #18):
+rank 0 saves ``ckpt_{epoch}`` every ``checkpoint_epoch`` epochs, then a
+barrier so no reader races the writer. Divergences, deliberate and documented:
+
+- the saved tree is the *unwrapped* state (quirk Q4: the reference saves the
+  DDP-wrapped, ``module.``-prefixed state dict; the accelerate path saves
+  unwrapped — tpuddp follows the accelerate/unwrapped convention);
+- a load/resume path exists (the reference only documents loading,
+  README.md:51-52).
+
+Format: a single ``.npz`` holding flattened leaves keyed by their pytree
+paths. PRNG key arrays are stored via ``jax.random.key_data`` and re-wrapped
+on load. Loading requires a template ("like") pytree for the treedef — the
+natural JAX analog of ``model.load_state_dict``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+from tpuddp.parallel import collectives as col
+
+_KEY_MARK = "__prngkey__"
+
+
+def _path_str(path) -> str:
+    return jax.tree_util.keystr(path)
+
+
+def save(path: str, tree: Any) -> str:
+    """Serialize a pytree to ``path`` (.npz). Caller handles rank gating."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    payload = {}
+    for p, leaf in flat:
+        key = _path_str(p)
+        arr = leaf
+        if hasattr(arr, "dtype") and jax.dtypes.issubdtype(arr.dtype, jax.dtypes.prng_key):
+            payload[_KEY_MARK + key] = np.asarray(jax.random.key_data(arr))
+        else:
+            payload[key] = np.asarray(arr)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)  # atomic publish, no torn checkpoints
+    return path
+
+
+def load(path: str, like: Any) -> Any:
+    """Restore a pytree saved by :func:`save`, using ``like`` for structure."""
+    with np.load(path) as data:
+        stored = dict(data.items())
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    for p, template in flat:
+        key = _path_str(p)
+        if key in stored:
+            leaves.append(stored[key])
+        elif _KEY_MARK + key in stored:
+            leaves.append(jax.random.wrap_key_data(stored[_KEY_MARK + key]))
+        else:
+            raise KeyError(f"checkpoint {path} is missing leaf {key!r}")
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def checkpoint_path(save_dir: str, epoch: int) -> str:
+    """``ckpt_{epoch}.npz`` — naming parity with the reference's
+    ``ckpt_{epoch}.pt`` (multi-GPU-training-torch.py:219-221)."""
+    return os.path.join(save_dir, f"ckpt_{epoch}.npz")
+
+
+def save_on_main(save_dir: str, epoch: int, tree: Any) -> Optional[str]:
+    """Process-0-only save + barrier — the reference's writer discipline
+    (:217-223). Returns the path on process 0, None elsewhere."""
+    path = None
+    if jax.process_index() == 0:
+        os.makedirs(save_dir, exist_ok=True)
+        path = save(checkpoint_path(save_dir, epoch), tree)
+    col.barrier("tpuddp_checkpoint")
+    return path
+
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
+
+
+def latest(save_dir: str) -> Optional[Tuple[str, int]]:
+    """Most recent ``(path, epoch)`` in ``save_dir``, or None. The resume
+    helper the reference lacks (SURVEY.md §3.4)."""
+    if not os.path.isdir(save_dir):
+        return None
+    best = None
+    for name in os.listdir(save_dir):
+        m = _CKPT_RE.match(name)
+        if m:
+            epoch = int(m.group(1))
+            if best is None or epoch > best[1]:
+                best = (os.path.join(save_dir, name), epoch)
+    return best
+
+
+def restore_latest(save_dir: str, like: Any) -> Tuple[Any, int]:
+    """Load the newest checkpoint into ``like``'s structure. Returns
+    ``(tree, next_epoch)``; ``(like, 0)`` when none exists."""
+    found = latest(save_dir)
+    if found is None:
+        return like, 0
+    path, epoch = found
+    return load(path, like), epoch + 1

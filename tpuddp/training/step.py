@@ -1,0 +1,226 @@
+"""Compiled data-parallel train/eval steps.
+
+The reference's hot loop (multi-GPU-training-torch.py:109-132) — H2D copy,
+zero_grad, forward, loss, backward (NCCL grad allreduce via DDP hooks),
+optimizer step, ``loss.item()`` — becomes ONE jitted function here. Two
+construction modes, both over the same mesh/collectives backend:
+
+- ``mode="shard_map"`` — the *explicit* analog of native DDP: a per-replica
+  function in which the gradient averaging is a visible ``lax.pmean`` over the
+  ``"data"`` axis (exactly DDP's bucketed allreduce contract, SURVEY.md §2b
+  #13), BatchNorm syncs stats with ``lax.pmean`` when converted (SyncBatchNorm
+  contract), and metrics come back as per-replica partial sums — the analog of
+  the reference's device-tensor accumulators that get ``dist.all_reduce``-d at
+  epoch end (:198-204).
+
+- ``mode="auto"`` — the *managed* analog (what the accelerate entrypoint
+  routes through): plain global-batch code under ``jit`` with NamedShardings;
+  XLA derives the same psum from the mean-loss data flow. BatchNorm statistics
+  are global-batch by construction here.
+
+Batches are ``(x, y, w)`` with a per-sample weight/mask so final partial
+batches can be padded to a static shape (TPU-first: no recompiles) while the
+sample-weighted metric math of the reference (:129-132) stays exact.
+
+Optional ``augment`` / ``transform`` hooks run *inside* the step on device —
+this is where tpuddp's CIFAR pipeline does resize/flip/normalize on-chip,
+fused into the forward pass by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpuddp import optim as _optim
+from tpuddp.nn.core import Context
+from tpuddp.parallel import collectives as col
+from tpuddp.parallel.mesh import DATA_AXIS, data_sharded, replicated
+from tpuddp.seeding import fold_in_axis_index
+from tpuddp.training.train_state import TrainState
+
+
+def _split_step_rng(state: TrainState, axis_name: Optional[str]):
+    """Per-step key; inside shard_map additionally fold in the replica index so
+    dropout/augmentation masks differ across replicas (device-level rank fold,
+    mirroring the reference's per-rank seeds)."""
+    rng = jax.random.fold_in(state.rng, state.step)
+    if axis_name is not None:
+        rng = fold_in_axis_index(rng, axis_name)
+    return jax.random.split(rng)
+
+
+def _make_train_core(
+    model,
+    criterion,
+    optimizer,
+    axis_name: Optional[str],
+    sync_buffers: str,
+    clip_grad_norm: Optional[float],
+    augment: Optional[Callable],
+):
+    def core(state: TrainState, x, y, w):
+        aug_rng, dropout_rng = _split_step_rng(state, axis_name)
+        if augment is not None:
+            x = augment(aug_rng, x)
+
+        def loss_fn(params):
+            ctx = Context(train=True, rng=dropout_rng, axis_name=axis_name)
+            logits, model_state = model.apply(params, state.model_state, x, ctx)
+            loss = criterion(logits, y, w)
+            return loss, model_state
+
+        (loss, model_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+
+        if axis_name is not None:
+            # THE DDP step: average gradients across replicas (reference :125's
+            # implicit NCCL allreduce). In auto mode XLA inserts this itself.
+            grads = col.pmean(grads, axis_name)
+        if clip_grad_norm is not None:
+            # clip-before-aggregate caveat (reference README): clip the
+            # *averaged* grad, identically on all replicas.
+            grads, _ = _optim.clip_grad_norm_(grads, clip_grad_norm)
+
+        new_params, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+
+        if axis_name is not None and sync_buffers == "broadcast":
+            # torch DDP's default broadcast_buffers=True: unsynced BN buffers
+            # follow rank 0. Synced BN already produced identical buffers.
+            model_state = col.broadcast(model_state, root=0, axis_name=axis_name)
+
+        n = jnp.sum(w)
+        metrics = {
+            "loss_sum": (loss * n)[None],  # sample-weighted, reference :131
+            "n": n[None],
+        }
+        new_state = TrainState(
+            params=new_params,
+            model_state=model_state,
+            opt_state=new_opt_state,
+            step=state.step + 1,
+            rng=state.rng,
+        )
+        return new_state, metrics
+
+    return core
+
+
+def _make_eval_core(model, criterion, axis_name, transform: Optional[Callable]):
+    def core(state: TrainState, x, y, w):
+        if transform is not None:
+            x = transform(x)
+        ctx = Context(train=False, rng=None, axis_name=axis_name)
+        logits, _ = model.apply(state.params, state.model_state, x, ctx)
+        loss = criterion(logits, y, w)
+        n = jnp.sum(w)
+        predicted = jnp.argmax(logits, axis=-1)
+        correct = jnp.sum((predicted == y) * w)
+        return {
+            "loss_sum": (loss * n)[None],
+            "correct": correct[None],
+            "n": n[None],
+        }
+
+    return core
+
+
+def build_train_step(
+    model,
+    criterion,
+    optimizer,
+    mesh,
+    mode: str = "shard_map",
+    sync_buffers: str = "broadcast",
+    clip_grad_norm: Optional[float] = None,
+    augment: Optional[Callable] = None,
+):
+    """Compile the DP train step over ``mesh``. Returns
+    ``step(state, (x, y, w)) -> (new_state, metrics)`` with donated state."""
+    if mode == "shard_map":
+        core = _make_train_core(
+            model, criterion, optimizer, DATA_AXIS, sync_buffers, clip_grad_norm, augment
+        )
+        fn = jax.shard_map(
+            core,
+            mesh=mesh,
+            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(P(), {"loss_sum": P(DATA_AXIS), "n": P(DATA_AXIS)}),
+            check_vma=False,
+        )
+        jitted = jax.jit(fn, donate_argnums=0)
+    elif mode == "auto":
+        core = _make_train_core(
+            model, criterion, optimizer, None, sync_buffers, clip_grad_norm, augment
+        )
+        jitted = jax.jit(
+            core,
+            in_shardings=(replicated(mesh), data_sharded(mesh), data_sharded(mesh), data_sharded(mesh)),
+            out_shardings=(replicated(mesh), replicated(mesh)),
+            donate_argnums=0,
+        )
+    else:
+        raise ValueError(f"unknown mode {mode!r}; one of 'shard_map', 'auto'")
+
+    def step(state, batch):
+        x, y, w = batch
+        return jitted(state, x, y, w)
+
+    return step
+
+
+def build_eval_step(
+    model,
+    criterion,
+    mesh,
+    mode: str = "shard_map",
+    transform: Optional[Callable] = None,
+):
+    """Compile the DP eval step: ``eval_step(state, (x, y, w)) -> metrics``
+    (per-replica partial sums in shard_map mode, global sums in auto mode)."""
+    if mode == "shard_map":
+        core = _make_eval_core(model, criterion, DATA_AXIS, transform)
+        fn = jax.shard_map(
+            core,
+            mesh=mesh,
+            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs={"loss_sum": P(DATA_AXIS), "correct": P(DATA_AXIS), "n": P(DATA_AXIS)},
+            check_vma=False,
+        )
+        jitted = jax.jit(fn)
+    elif mode == "auto":
+        core = _make_eval_core(model, criterion, None, transform)
+        jitted = jax.jit(
+            core,
+            in_shardings=(replicated(mesh), data_sharded(mesh), data_sharded(mesh), data_sharded(mesh)),
+            out_shardings=replicated(mesh),
+        )
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+    def step(state, batch):
+        x, y, w = batch
+        return jitted(state, x, y, w)
+
+    return step
+
+
+def accumulate_metrics(acc, new):
+    """On-device accumulation of per-step metric sums (fixes quirk Q5 — no
+    ``loss.item()`` host sync per batch; dispatch stays async)."""
+    if acc is None:
+        return new
+    return jax.tree_util.tree_map(jnp.add, acc, new)
+
+
+def finalize_metrics(acc):
+    """Epoch-end aggregation: one cross-device sum per metric — the analog of
+    the reference's five ``dist.all_reduce`` calls (:198-204) — then a single
+    host fetch."""
+    if acc is None:
+        return {}
+    return {k: float(col.host_sum(v)) for k, v in acc.items()}

@@ -1,0 +1,51 @@
+"""TrainState: the one pytree that flows through the jitted train step.
+
+Bundles what the reference keeps as four Python objects — model params (inside
+``DDP(model)``), BatchNorm buffers, ``optim.Adam`` state, and the implicit
+step/RNG bookkeeping — so the whole update is a single pure function
+``(state, batch) -> state`` that XLA compiles once and keeps resident in HBM
+(fixing quirk Q5: no per-batch host sync, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    model_state: Any
+    opt_state: Any
+    step: jax.Array
+    rng: jax.Array
+
+
+jax.tree_util.register_dataclass(
+    TrainState,
+    data_fields=["params", "model_state", "opt_state", "step", "rng"],
+    meta_fields=[],
+)
+
+
+def create_train_state(model, optimizer, key, sample_input) -> TrainState:
+    """Initialize params/buffers/optimizer state from a sample input.
+
+    The caller passes the *same* key on every process (tpuddp's analog of DDP's
+    construction-time rank-0 parameter broadcast, multi-GPU-training-torch.py:245,
+    is done in DistributedDataParallel.init_state via broadcast_one_to_all).
+    """
+    init_key, run_key = jax.random.split(key)
+    params, model_state = model.init(init_key, sample_input)
+    opt_state = optimizer.init(params)
+    return TrainState(
+        params=params,
+        model_state=model_state,
+        opt_state=opt_state,
+        step=jnp.zeros((), jnp.int32),
+        rng=run_key,
+    )
