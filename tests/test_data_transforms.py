@@ -1,0 +1,153 @@
+"""CIFAR-10 parsing + device-side transform parity with the reference's
+torchvision pipeline (data_and_toy_model.py:13-36)."""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuddp.data import cifar10 as c10
+from tpuddp.data import transforms as T
+
+
+@pytest.fixture(scope="module")
+def fake_cifar_root(tmp_path_factory):
+    """Write a tiny on-disk CIFAR-10 in both formats."""
+    root = tmp_path_factory.mktemp("cifar")
+    rng = np.random.RandomState(0)
+
+    pydir = root / c10.PY_DIR
+    pydir.mkdir()
+    for name in c10.TRAIN_PY + c10.TEST_PY:
+        n = 20
+        data = rng.randint(0, 256, (n, 3072), dtype=np.uint8)
+        labels = rng.randint(0, 10, n).tolist()
+        with open(pydir / name, "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels}, f)
+
+    bindir = root / c10.BIN_DIR
+    bindir.mkdir()
+    for name in c10.TRAIN_BIN + c10.TEST_BIN:
+        n = 20
+        rows = np.concatenate(
+            [
+                rng.randint(0, 10, (n, 1), dtype=np.uint8),
+                rng.randint(0, 256, (n, 3072), dtype=np.uint8),
+            ],
+            axis=1,
+        )
+        rows.tofile(str(bindir / name))
+    return str(root)
+
+
+def test_cifar10_py_format(fake_cifar_root):
+    ds = c10.CIFAR10(fake_cifar_root, train=True)
+    assert ds.images.shape == (100, 32, 32, 3)
+    assert ds.images.dtype == np.uint8
+    assert ds.labels.shape == (100,)
+    x, y = ds.get_batch([0, 5, 7])
+    assert x.shape == (3, 32, 32, 3)
+
+
+def test_cifar10_bin_format(fake_cifar_root, tmp_path):
+    # point directly at the bin dir via a root that only contains it
+    import shutil
+
+    root = tmp_path / "only_bin"
+    root.mkdir()
+    shutil.copytree(
+        os.path.join(fake_cifar_root, c10.BIN_DIR), root / c10.BIN_DIR
+    )
+    ds = c10.CIFAR10(str(root), train=False)
+    assert ds.images.shape == (20, 32, 32, 3)
+    assert 0 <= ds.labels.min() and ds.labels.max() < 10
+
+
+def test_missing_dataset_raises_clearly(tmp_path):
+    with pytest.raises(FileNotFoundError, match="CIFAR-10 not found"):
+        c10.CIFAR10(str(tmp_path / "nothing"), download=False)
+
+
+def test_load_datasets_synthetic_fallback(tmp_path):
+    train, test = c10.load_datasets(
+        str(tmp_path / "nope"), download=False, synthetic_fallback=True
+    )
+    assert train.images.dtype == np.uint8
+    assert len(train) > len(test)
+
+
+def test_channel_order_is_rgb_planes(fake_cifar_root):
+    """Reference format: 3072 bytes = R plane, G plane, B plane."""
+    ds = c10.CIFAR10(fake_cifar_root, train=True)
+    with open(os.path.join(fake_cifar_root, c10.PY_DIR, "data_batch_1"), "rb") as f:
+        raw = pickle.load(f, encoding="bytes")[b"data"][0]
+    np.testing.assert_array_equal(ds.images[0, :, :, 0].reshape(-1), raw[:1024])
+    np.testing.assert_array_equal(ds.images[0, :, :, 2].reshape(-1), raw[2048:])
+
+
+# ---- transforms ----
+
+
+def test_to_float_and_normalize_matches_torchvision_math():
+    x = np.random.RandomState(1).randint(0, 256, (2, 32, 32, 3), dtype=np.uint8)
+    out = T.normalize(T._to_float(jnp.asarray(x)))
+    manual = (x.astype(np.float32) / 255.0 - np.array(c10.CIFAR10_MEAN)) / np.array(
+        c10.CIFAR10_STD
+    )
+    np.testing.assert_allclose(np.asarray(out), manual, rtol=1e-5, atol=1e-6)
+
+
+def test_resize_matches_torch_bilinear():
+    import torch
+    import torch.nn.functional as F
+
+    x = np.random.RandomState(2).rand(2, 32, 32, 3).astype(np.float32)
+    ours = T.resize(jnp.asarray(x), 64)
+    ref = F.interpolate(
+        torch.from_numpy(x.transpose(0, 3, 1, 2)),
+        size=64,
+        mode="bilinear",
+        align_corners=False,
+    ).numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_random_flip_is_per_sample_and_mirrors():
+    x = np.arange(2 * 4 * 4 * 1, dtype=np.float32).reshape(2, 4, 4, 1)
+    flipped_all = T.random_horizontal_flip(jax.random.key(0), jnp.asarray(x), p=1.0)
+    np.testing.assert_array_equal(np.asarray(flipped_all), x[:, :, ::-1, :])
+    none = T.random_horizontal_flip(jax.random.key(0), jnp.asarray(x), p=0.0)
+    np.testing.assert_array_equal(np.asarray(none), x)
+    # p=0.5 over a big batch: both outcomes occur
+    big = jnp.ones((64, 2, 2, 1)).at[:, 0, 0, 0].set(jnp.arange(64.0))
+    out = T.random_horizontal_flip(jax.random.key(1), big)
+    changed = np.any(np.asarray(out) != np.asarray(big), axis=(1, 2, 3))
+    assert 0 < changed.sum() < 64
+
+
+def test_train_augment_end_to_end_shapes_and_range():
+    aug = T.make_train_augment(size=64)
+    x = jnp.asarray(
+        np.random.RandomState(3).randint(0, 256, (4, 32, 32, 3), dtype=np.uint8)
+    )
+    out = aug(jax.random.key(0), x)
+    assert out.shape == (4, 64, 64, 3)
+    assert out.dtype == jnp.float32
+    assert float(jnp.abs(out).max()) < 4.0  # normalized range
+
+
+def test_eval_transform_no_resize_when_size_none():
+    t = T.make_eval_transform(size=None)
+    x = jnp.zeros((2, 32, 32, 3), jnp.uint8)
+    out = t(x)
+    assert out.shape == (2, 32, 32, 3)
+
+
+def test_augment_is_jittable():
+    aug = T.make_train_augment(size=48)
+    f = jax.jit(aug)
+    out = f(jax.random.key(0), jnp.zeros((2, 32, 32, 3), jnp.uint8))
+    assert out.shape == (2, 48, 48, 3)

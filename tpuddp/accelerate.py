@@ -1,0 +1,335 @@
+"""Accelerator — the managed two-level-API facade (SURVEY.md §2b #15).
+
+Mirrors the HuggingFace ``Accelerator`` surface the reference's second
+entrypoint uses (multi-GPU-training-accelerate.py:115-131,53,96,104-108):
+``prepare``, ``backward``, ``device``, ``is_local_main_process``,
+``is_main_process``, ``wait_for_everyone``, ``save_model``, ``gather`` — and
+routes every one of them through the SAME mesh/collectives backend as the
+explicit DistributedDataParallel API (the two-level contract of SURVEY.md §1).
+
+JAX is functional, so the torch-imperative sequence
+
+    outputs = model(inputs)          # forward
+    loss = criterion(outputs, labels)
+    accelerator.backward(loss)       # backward + grad sync
+    optimizer.step()                 # param update
+
+is bridged lazily: ``model(inputs)`` returns a :class:`LazyForward` and
+``criterion(...)`` a :class:`LazyLoss`; nothing runs until
+``accelerator.backward(loss)``, which executes ONE jitted global-batch
+value_and_grad over the data-sharded mesh (gradient cross-replica reduction
+falls out of XLA's data flow — the managed analog of DDP's allreduce),
+stashes the averaged grads, and caches the loss value so a later
+``loss.item()`` is free. ``optimizer.step()`` then applies the native
+optimizer update. ``zero_grad()`` is the traditional no-op.
+
+Managed-mode BatchNorm note: batch statistics are computed over the *global*
+sharded batch under jit, i.e. SyncBatchNorm semantics by construction — the
+behavior the reference README recommends turning on (README.md:79-81).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuddp import optim as optim_lib
+from tpuddp import seeding
+from tpuddp.data.loader import DataLoader, ShardedDataLoader
+from tpuddp.nn.core import Context, Module
+from tpuddp.parallel import collectives as col
+from tpuddp.parallel.mesh import data_mesh, replicated, shard_batch
+from tpuddp.training import checkpoint as ckpt
+
+
+class LazyForward:
+    """Deferred forward pass: records (model, inputs); materializes on demand."""
+
+    def __init__(self, model: "PreparedModel", x):
+        self._model = model
+        self._x = x
+        self._logits = None
+
+    # hook consumed by tpuddp criterions (see nn/loss.py)
+    def _tpuddp_bind_loss(self, criterion, labels, weights=None):
+        return LazyLoss(self, criterion, labels, weights)
+
+    @property
+    def value(self):
+        if self._logits is None:
+            self._logits = self._model._forward_concrete(self._x)
+        return self._logits
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self.value)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def argmax(self, axis=-1):
+        return jnp.argmax(self.value, axis=axis)
+
+
+class LazyLoss:
+    """Deferred loss: executed by ``Accelerator.backward`` (fused fwd+bwd) or
+    by ``.item()`` (forward only, e.g. in eval loops)."""
+
+    def __init__(self, fwd: LazyForward, criterion, labels, weights):
+        self._fwd = fwd
+        self._criterion = criterion
+        self._labels = labels
+        self._weights = weights
+        self._value = None
+
+    def _run_backward(self):
+        model = self._fwd._model
+        loss = model._backward(
+            self._fwd._x, self._labels, self._weights, self._criterion
+        )
+        self._value = loss
+
+    def item(self) -> float:
+        if self._value is None:
+            logits = jnp.asarray(self._fwd.value)
+            self._value = self._criterion(
+                logits, jnp.asarray(self._labels), self._weights
+            )
+        return float(self._value)
+
+    def __float__(self):
+        return self.item()
+
+
+class PreparedModel:
+    """The managed model: owns params/buffers, a compiled sharded train
+    grad-step, and compiled replicated inference forwards. Mode toggles
+    (``train()``/``eval()``) mirror ``nn.Module`` semantics."""
+
+    def __init__(self, accelerator: "Accelerator", module: Module):
+        self.accelerator = accelerator
+        self.module = module
+        self.params = None
+        self.model_state = None
+        self._training = True
+        self._grad_step = None
+        self._fwd = {}
+        self._pending_grads = None
+
+    # -- torch-parity mode switches --
+    def train(self):
+        self._training = True
+        return self
+
+    def eval(self):
+        self._training = False
+        return self
+
+    def _ensure_init(self, x):
+        if self.params is not None:
+            return
+        key = self.accelerator._next_key()
+        sample = jax.ShapeDtypeStruct((1,) + tuple(np.shape(x))[1:], jnp.asarray(x[:1]).dtype)
+        params, mstate = self.module.init(key, sample)
+        params, mstate = col.broadcast_one_to_all((params, mstate))
+        sharding = replicated(self.accelerator.mesh)
+        self.params = jax.device_put(params, sharding)
+        self.model_state = jax.device_put(mstate, sharding)
+
+    def __call__(self, x) -> LazyForward:
+        self._ensure_init(x)
+        return LazyForward(self, x)
+
+    # -- concrete executions --
+    def _forward_concrete(self, x):
+        """Replicated-batch forward (used for eval / output materialization).
+        Unprepared eval loaders feed the FULL batch to every process — the
+        reference's accelerate eval behavior (quirk Q3)."""
+        train = self._training
+        key = (np.shape(x), train)
+        if key not in self._fwd:
+            def fwd(params, mstate, xv, rng):
+                ctx = Context(train=train, rng=rng, axis_name=None)
+                logits, _ = self.module.apply(params, mstate, xv, ctx)
+                return logits
+
+            self._fwd[key] = jax.jit(fwd)
+        rng = self.accelerator._next_key() if train else jax.random.key(0)
+        xr = jax.device_put(jnp.asarray(x), replicated(self.accelerator.mesh))
+        return self._fwd[key](self.params, self.model_state, xr, rng)
+
+    def _get_grad_step(self, criterion):
+        if self._grad_step is None or self._grad_step[0] is not criterion:
+            def grad_step(params, mstate, rng, x, y, w):
+                def loss_fn(p):
+                    ctx = Context(train=True, rng=rng, axis_name=None)
+                    logits, new_mstate = self.module.apply(p, mstate, x, ctx)
+                    return criterion(logits, y, w), new_mstate
+
+                (loss, new_mstate), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                return loss, grads, new_mstate
+
+            self._grad_step = (criterion, jax.jit(grad_step))
+        return self._grad_step[1]
+
+    def _backward(self, x, y, w, criterion):
+        mesh = self.accelerator.mesh
+        xb, yb = shard_batch(mesh, (jnp.asarray(x), jnp.asarray(y)))
+        wb = shard_batch(
+            mesh, jnp.asarray(w if w is not None else np.ones(len(y), np.float32))
+        )
+        rng = self.accelerator._next_key()
+        fn = self._get_grad_step(criterion)
+        loss, grads, new_mstate = fn(self.params, self.model_state, rng, xb, yb, wb)
+        self.model_state = new_mstate
+        self._pending_grads = grads
+        return loss
+
+
+class PreparedOptimizer:
+    """Wraps a tpuddp optimizer; ``step()`` applies the grads stashed by the
+    last ``accelerator.backward`` (torch call-order parity)."""
+
+    def __init__(self, optimizer: optim_lib.Optimizer, model: PreparedModel):
+        self.optimizer = optimizer
+        self.model = model
+        self.opt_state = None
+
+    def zero_grad(self):
+        self.model._pending_grads = None
+
+    def step(self):
+        grads = self.model._pending_grads
+        if grads is None:
+            raise RuntimeError(
+                "optimizer.step() called without a preceding accelerator.backward(loss)"
+            )
+        if self.opt_state is None:
+            self.opt_state = self.optimizer.init(self.model.params)
+        self.model.params, self.opt_state = self.optimizer.update(
+            grads, self.opt_state, self.model.params
+        )
+        self.model._pending_grads = None
+
+
+class Accelerator:
+    """Managed entry to the tpuddp backend. Topology comes from the live JAX
+    runtime (the analog of HF accelerate reading torchrun env vars)."""
+
+    def __init__(self, mesh=None, seed: Optional[int] = None):
+        self.mesh = mesh if mesh is not None else data_mesh()
+        key, _ = seeding.set_seed_based_on_rank(base_seed=seed)
+        self._key = key
+        self._models = []
+
+    # -- topology (HF property-name parity) --
+    @property
+    def device(self):
+        return self.mesh.devices.flat[0]
+
+    @property
+    def num_processes(self) -> int:
+        return jax.process_count()
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def local_process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def is_main_process(self) -> bool:
+        return jax.process_index() == 0
+
+    @property
+    def is_local_main_process(self) -> bool:
+        return jax.process_index() == 0
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- the core verbs --
+    def prepare(self, *objects):
+        """Wrap (model, optimizer, dataloader) for distributed execution —
+        reference usage at multi-GPU-training-accelerate.py:129-131. DataLoaders
+        are re-created sharded (each process loads only its replicas' shard;
+        batch_size stays per-replica, matching HF semantics and the README's
+        memory caveat, README.md:72-73). Objects deliberately NOT prepared
+        (the reference's test_loader) keep their full unsharded stream."""
+        out = []
+        model_ctx: Optional[PreparedModel] = None
+        for obj in objects:
+            if isinstance(obj, Module):
+                model_ctx = PreparedModel(self, obj)
+                self._models.append(model_ctx)
+                out.append(model_ctx)
+            elif isinstance(obj, PreparedModel):
+                model_ctx = obj
+                out.append(obj)
+            elif isinstance(obj, optim_lib.Optimizer):
+                out.append(("optimizer", obj))
+            elif isinstance(obj, (DataLoader, ShardedDataLoader)):
+                out.append(obj)
+            else:
+                raise TypeError(f"cannot prepare object of type {type(obj)!r}")
+        # bind optimizers to the model prepared in the same call
+        for i, obj in enumerate(out):
+            if isinstance(obj, tuple) and obj[0] == "optimizer":
+                if model_ctx is None:
+                    raise ValueError("prepare() got an optimizer but no model")
+                out[i] = PreparedOptimizer(obj[1], model_ctx)
+        out = [
+            ShardedDataLoader(
+                o.dataset, o.batch_size, self.mesh,
+                shuffle=o.shuffle or o.sampler is not None,
+                seed=o.seed,
+            )
+            if isinstance(o, DataLoader)
+            else o
+            for o in out
+        ]
+        return out[0] if len(out) == 1 else tuple(out)
+
+    def backward(self, loss: LazyLoss):
+        """Fused forward+backward+grad-sync (reference :53's
+        ``accelerator.backward(loss)``)."""
+        if not isinstance(loss, LazyLoss):
+            raise TypeError(
+                "accelerator.backward expects the LazyLoss produced by a tpuddp "
+                "criterion applied to a prepared model's outputs"
+            )
+        loss._run_backward()
+
+    def wait_for_everyone(self):
+        """Global barrier (reference :106)."""
+        col.barrier("tpuddp_accelerate_wait")
+
+    def save_model(self, model: PreparedModel, save_dir: str):
+        """Single-writer save of the *unwrapped* weights (reference :108's
+        ``accelerator.save_model`` contract): process 0 writes
+        ``save_dir/model.npz``."""
+        if self.is_main_process:
+            os.makedirs(save_dir, exist_ok=True)
+            ckpt.save(
+                os.path.join(save_dir, "model.npz"),
+                {"params": model.params, "model_state": model.model_state},
+            )
+        col.barrier("tpuddp_accelerate_save")
+
+    def gather(self, x):
+        """Concatenate a data-sharded array's shards onto every host."""
+        from jax.experimental import multihost_utils
+
+        if jax.process_count() > 1:
+            return multihost_utils.process_allgather(x)
+        return np.asarray(x)
+
+    def print(self, *args, **kwargs):
+        if self.is_local_main_process:
+            print(*args, **kwargs)

@@ -1,0 +1,90 @@
+"""Device-side image transforms — the TPU-first replacement for the
+reference's CPU-side torchvision pipeline (data_and_toy_model.py:13-29).
+
+The reference resizes every 32x32 CIFAR image to 224x224 float32 on the host
+and ships ~588 KB/sample through the dataloader; tpuddp ships the raw 3 KB
+uint8 sample to HBM and runs Resize + RandomHorizontalFlip + Normalize
+*inside* the jitted train step, where XLA fuses the elementwise work into the
+surrounding compute. The augment hook signature matches
+``training.step.build_train_step(augment=...)``: ``augment(rng, x) -> x``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpuddp.data.cifar10 import CIFAR10_MEAN, CIFAR10_STD
+
+
+def _to_float(x: jax.Array) -> jax.Array:
+    """uint8 [0,255] -> float32 [0,1] (torchvision ToTensor semantics); pass
+    floats through unchanged."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(jnp.float32)
+    return x.astype(jnp.float32) / 255.0
+
+
+def resize(x: jax.Array, size: int) -> jax.Array:
+    """Bilinear resize of an NHWC batch to (size, size) — Resize(224) analog."""
+    n, _, _, c = x.shape
+    return jax.image.resize(x, (n, size, size, c), method="bilinear")
+
+
+def normalize(
+    x: jax.Array,
+    mean: Sequence[float] = CIFAR10_MEAN,
+    std: Sequence[float] = CIFAR10_STD,
+) -> jax.Array:
+    return (x - jnp.asarray(mean, x.dtype)) / jnp.asarray(std, x.dtype)
+
+
+def random_horizontal_flip(rng: jax.Array, x: jax.Array, p: float = 0.5) -> jax.Array:
+    """Per-sample flip (torchvision RandomHorizontalFlip): one Bernoulli per
+    image, applied via a select — no dynamic shapes, fully fusible."""
+    flip = jax.random.bernoulli(rng, p, (x.shape[0], 1, 1, 1))
+    return jnp.where(flip, x[:, :, ::-1, :], x)
+
+
+def make_train_augment(
+    size: Optional[int] = 224,
+    flip: bool = True,
+    mean: Sequence[float] = CIFAR10_MEAN,
+    std: Sequence[float] = CIFAR10_STD,
+    compute_dtype=jnp.float32,
+):
+    """The reference's transform_train (Resize, RandomHorizontalFlip, ToTensor,
+    Normalize — data_and_toy_model.py:13-20), reordered so the cheap ops run on
+    the small 32x32 image and the resize output feeds the conv directly."""
+
+    def augment(rng: jax.Array, x: jax.Array) -> jax.Array:
+        x = _to_float(x)
+        if flip:
+            x = random_horizontal_flip(rng, x)
+        x = normalize(x, mean, std)
+        if size is not None and (x.shape[1] != size or x.shape[2] != size):
+            x = resize(x, size)
+        return x.astype(compute_dtype)
+
+    return augment
+
+
+def make_eval_transform(
+    size: Optional[int] = 224,
+    mean: Sequence[float] = CIFAR10_MEAN,
+    std: Sequence[float] = CIFAR10_STD,
+    compute_dtype=jnp.float32,
+):
+    """transform_test analog (no flip, data_and_toy_model.py:22-29)."""
+
+    def transform(x: jax.Array) -> jax.Array:
+        x = _to_float(x)
+        x = normalize(x, mean, std)
+        if size is not None and (x.shape[1] != size or x.shape[2] != size):
+            x = resize(x, size)
+        return x.astype(compute_dtype)
+
+    return transform

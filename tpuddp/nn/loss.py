@@ -46,4 +46,10 @@ class CrossEntropyLoss:
         self.reduction = reduction
 
     def __call__(self, logits, labels, weights=None):
+        # Managed-API hook: applied to a prepared model's deferred outputs
+        # (tpuddp.accelerate.LazyForward), return a deferred loss that
+        # Accelerator.backward executes as one fused fwd+bwd.
+        bind = getattr(logits, "_tpuddp_bind_loss", None)
+        if bind is not None:
+            return bind(self, labels, weights)
         return cross_entropy(logits, labels, self.reduction, weights)

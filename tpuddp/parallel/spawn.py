@@ -1,0 +1,93 @@
+"""Process launch — the tpuddp analog of ``torch.multiprocessing.spawn``
+(SURVEY.md §2b #14; reference run_DDP_training, multi-GPU-training-torch.py:269-279).
+
+The reference forks one process per GPU on one node. The TPU execution model
+inverts this: each host of a pod slice runs ONE process that owns all of its
+local chips (``jax.process_index()`` is the rank), and single-host multi-chip
+needs no spawn at all. So:
+
+- :func:`run_ddp_training` calls the worker once per process with
+  ``(rank=process_index, world_size, save_dir, optional_args)`` — signature
+  parity with the reference's ``demo_fn`` — after bootstrapping the runtime.
+- :func:`maybe_reexec_for_world` reproduces the *development* experience of
+  spawning an N-way world on a chipless box: if the CPU rung can't see N
+  virtual devices yet, it re-execs the current script with
+  ``--xla_force_host_platform_device_count=N`` set, which must happen before
+  XLA initializes (the reason mp.spawn-style in-process forking can't work
+  with a live XLA runtime).
+- Worker exceptions propagate (mp.spawn ``join=True`` contract) since there is
+  no intermediate process on the single-host path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Callable, Optional
+
+import jax
+
+from tpuddp.parallel import backend as _backend
+
+logger = logging.getLogger("tpuddp")
+
+_REEXEC_GUARD = "TPUDDP_SPAWNED"
+
+
+def maybe_reexec_for_world(world_size: int, backend: Optional[str] = None) -> None:
+    """Dev-mode launcher: ensure an N-device CPU world exists, re-execing the
+    current process with XLA_FLAGS if needed. No-op when enough devices (of
+    the resolved backend) are already visible or when already re-execed."""
+    chosen = _backend.detect_backend(backend)
+    if chosen != "cpu":
+        return
+    if len(jax.devices("cpu")) >= world_size:
+        return
+    if os.environ.get(_REEXEC_GUARD):
+        raise RuntimeError(
+            f"re-exec with --xla_force_host_platform_device_count={world_size} "
+            f"still yields {len(jax.devices('cpu'))} CPU devices; XLA was "
+            "initialized before the flag took effect"
+        )
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={world_size}".strip()
+    )
+    env[_REEXEC_GUARD] = "1"
+    env.setdefault("TPUDDP_BACKEND", "cpu")
+    logger.info("re-exec for %d-device CPU world", world_size)
+    os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def run_ddp_training(
+    demo_fn: Callable,
+    world_size: Optional[int],
+    save_dir: str,
+    optional_args: dict,
+    backend: Optional[str] = None,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Launch DP training — signature parity with the reference's
+    ``run_DDP_training(demo_fn, world_size, save_dir, optional_args)`` (:269-279).
+
+    ``demo_fn(rank, world_size, save_dir, optional_args)`` runs once in this
+    process; rank is the process index (0 on single host). Exceptions
+    propagate like mp.spawn(join=True).
+    """
+    if world_size is not None:
+        maybe_reexec_for_world(world_size, backend)
+    _backend.setup(
+        world_size=world_size,
+        backend=backend,
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    try:
+        demo_fn(jax.process_index(), _backend.get_world_size(), save_dir, optional_args)
+    finally:
+        _backend.cleanup()

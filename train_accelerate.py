@@ -1,0 +1,195 @@
+"""Managed training entrypoint — the tpuddp analog of the reference's
+``multi-GPU-training-accelerate.py`` (call stack SURVEY.md §3.2): the
+``Accelerator`` hides process topology, sharding, and gradient sync, and
+routes through the same XLA backend as train_native.py.
+
+Deliberate reference-parity behaviors (quirk Q3, SURVEY.md §3.5): the test
+loader is NOT prepared, so eval runs the full test set on every process with
+per-batch-mean (not sample-weighted) averaging and no cross-process reduction
+— exactly like the reference (multi-GPU-training-accelerate.py:60-75,129-131).
+
+Usage parity:  python train_accelerate.py --settings_file local_settings.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpuddp import config as cfg_lib
+from tpuddp import nn, optim
+from tpuddp.accelerate import Accelerator
+from tpuddp.data import DataLoader
+from tpuddp.data.cifar10 import load_datasets
+from tpuddp.data.transforms import make_eval_transform
+
+logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+
+def setup_dataloaders(training):
+    """Plain, distribution-unaware loaders (reference :22-36); prepare() later
+    re-creates the train loader sharded."""
+    train_dataset, test_dataset = load_datasets(
+        training["data_root"], synthetic_fallback=True
+    )
+    train_loader = DataLoader(
+        train_dataset, batch_size=training["train_batch_size"], shuffle=True
+    )
+    test_loader = DataLoader(test_dataset, batch_size=training["test_batch_size"])
+    return train_loader, test_loader
+
+
+def train(model, train_loader, criterion, optimizer, accelerator, transform):
+    model.train()
+    running_loss = 0.0
+    for inputs, labels, weights in train_loader:
+        # no .to(device): placement is the backend's job (reference :44 note)
+        optimizer.zero_grad()
+
+        # Forward pass (deferred; fused with backward by the Accelerator)
+        outputs = model(transform_host(transform, inputs))
+        loss = criterion(outputs, labels, weights)
+
+        # Backward pass and optimize
+        accelerator.backward(loss)  # instead of loss.backward()
+        optimizer.step()
+
+        running_loss += loss.item()
+    return running_loss / len(train_loader)
+
+
+def transform_host(transform, inputs):
+    """Apply the eval/train-agnostic resize+normalize before the managed
+    forward (the managed path keeps the torch-like 'model(inputs)' shape, so
+    the transform runs as a separate jitted op rather than fused)."""
+    return transform(jnp.asarray(inputs))
+
+
+def evaluate(model, test_loader, criterion, device, transform):
+    model.eval()
+    correct = 0
+    total = 0
+    test_loss = 0.0
+    for inputs, labels, weights in test_loader:
+        inputs = transform_host(transform, inputs)
+        outputs = model(inputs)
+        loss = criterion(outputs, labels, weights)
+        test_loss += loss.item()
+        predicted = np.asarray(outputs.argmax(axis=-1))
+        mask = weights > 0
+        total += int(mask.sum())
+        correct += int(((predicted == labels) & mask).sum())
+    accuracy = 100 * correct / total
+    return test_loss / len(test_loader), accuracy
+
+
+def run_training_loop(
+    model,
+    train_loader,
+    test_loader,
+    criterion,
+    optimizer,
+    save_dir,
+    accelerator,
+    transform,
+    num_epochs=20,
+    checkpoint_epoch=5,
+):
+    for epoch in range(num_epochs):
+        train_loader.set_epoch(epoch)
+        train_loss = train(
+            model, train_loader, criterion, optimizer, accelerator, transform
+        )
+        test_loss, test_accuracy = evaluate(
+            model, test_loader, criterion, accelerator.device, transform
+        )
+
+        # only print loss vals for one process (reference :96-102)
+        if accelerator.is_local_main_process:
+            print(
+                f"Epoch {epoch + 1}/{num_epochs}, "
+                f"Train Loss: {train_loss:.4f}, "
+                f"Test Loss: {test_loss:.4f}, "
+                f"Test Accuracy: {test_accuracy:.2f}%"
+            )
+
+        if epoch % checkpoint_epoch == 0:
+            # Wait for all parallel runs to finish (reference :104-108)
+            accelerator.wait_for_everyone()
+            # Unwrap & save the distributed training interface
+            accelerator.save_model(model, save_dir)
+
+    print("Finished Training.")
+
+
+def basic_accelerate_training(out_dir: str, training=None):
+    training = training or cfg_lib.TRAINING_DEFAULTS
+    # Initialize accelerator state (reference :115)
+    accelerator = Accelerator(seed=training.get("seed"))
+
+    # Load data and model (reference :118-122); no .to(device) needed.
+    train_loader, test_loader = setup_dataloaders(training)
+    model = load_model_for(training)
+
+    criterion = nn.CrossEntropyLoss()
+    optimizer = optim.Adam(lr=training["learning_rate"])
+
+    # Prepare DDP with the accelerator (reference :129-131): test_loader is
+    # deliberately NOT prepared (quirk Q3 parity).
+    model, optimizer, training_dataloader = accelerator.prepare(
+        model, optimizer, train_loader
+    )
+
+    transform = make_eval_transform(size=training.get("image_size"))
+    run_training_loop(
+        model,
+        training_dataloader,
+        test_loader,
+        criterion,
+        optimizer,
+        out_dir,
+        accelerator,
+        transform,
+        num_epochs=training["num_epochs"],
+        checkpoint_epoch=training["checkpoint_epoch"],
+    )
+
+
+def load_model_for(training):
+    from tpuddp.models import load_model
+
+    model = load_model(training["model"])
+    if training.get("sync_bn"):
+        nn.convert_sync_batchnorm(model)
+    return model
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="Run script based on local_settings.yaml file.",
+    )
+    parser.add_argument(
+        "--settings_file",
+        type=str,
+        required=True,
+        help="Path to local_settings.yaml file specifying cluster settings and "
+        "other parameters.",
+    )
+    args = parser.parse_args()
+
+    settings = cfg_lib.load_settings(args.settings_file)
+    out_dir = cfg_lib.prepare_out_dir(settings, args.settings_file)
+    training = cfg_lib.training_config(settings)
+
+    # Managed path: world size comes from the runtime, not config — but honor
+    # the dev-mode CPU world request like the native entrypoint does.
+    world_size = cfg_lib.world_size_from(settings)
+    if world_size:
+        from tpuddp.parallel.spawn import maybe_reexec_for_world
+
+        maybe_reexec_for_world(world_size, cfg_lib.device_from(settings))
+
+    basic_accelerate_training(out_dir, training)

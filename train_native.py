@@ -1,0 +1,128 @@
+"""Explicit (native) DP training entrypoint — the tpuddp analog of the
+reference's ``multi-GPU-training-torch.py`` (call stack SURVEY.md §3.1).
+
+Same shape, TPU-native pieces:
+
+    setup/process group        -> tpuddp.parallel.backend (TPU->CPU ladder)
+    mp.spawn per-GPU workers   -> one process drives all local chips
+                                  (tpuddp.parallel.spawn.run_ddp_training)
+    set_seed_based_on_rank     -> tpuddp.seeding
+    DistributedSampler loaders -> ShardedDataLoader (per-replica samplers)
+    DDP(model) + NCCL allreduce-> DistributedDataParallel (shard_map + pmean)
+    run_training_loop          -> tpuddp.training.loop (same per-epoch flow)
+
+Usage parity:  python train_native.py --settings_file local_settings.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tpuddp import config as cfg_lib
+from tpuddp import nn, optim, seeding
+from tpuddp.data import ShardedDataLoader
+from tpuddp.data.cifar10 import load_datasets
+from tpuddp.data.transforms import make_eval_transform, make_train_augment
+from tpuddp.models import load_model
+from tpuddp.parallel.ddp import DistributedDataParallel
+from tpuddp.parallel.mesh import data_mesh
+from tpuddp.parallel.spawn import run_ddp_training
+from tpuddp.training.loop import run_training_loop
+
+logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+
+def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=None):
+    """Per-process worker — parity with the reference's
+    ``basic_DDP_training_loop`` (multi-GPU-training-torch.py:228-266). The
+    process group is already up (run_ddp_training called setup)."""
+    print(f"Running DDP training on process {rank} ({world_size}-chip world).")
+    training = training or cfg_lib.TRAINING_DEFAULTS
+
+    # Seeds per rank (reference :234); the data permutation seed stays shared
+    # across ranks (DistributedSampler contract) and independent of model seed.
+    key, _base_seed = seeding.set_seed_based_on_rank(rank, training.get("seed"))
+
+    mesh = data_mesh(world_size)
+
+    # Data + model (reference :237-238); synthetic fallback keeps the tutorial
+    # runnable with no dataset staged (zero-egress environments).
+    train_ds, test_ds = load_datasets(training["data_root"], synthetic_fallback=True)
+    train_loader = ShardedDataLoader(
+        train_ds, training["train_batch_size"], mesh, shuffle=True
+    )
+    test_loader = ShardedDataLoader(
+        test_ds, training["test_batch_size"], mesh, shuffle=True
+    )
+
+    model = load_model(training["model"])
+    if training.get("sync_bn"):
+        nn.convert_sync_batchnorm(model)
+
+    # Loss + optimizer (reference :248-249).
+    criterion = nn.CrossEntropyLoss()
+    optimizer = optim.Adam(lr=training["learning_rate"])
+
+    # Device-side transform pipeline (replaces data_and_toy_model.py:13-29).
+    size = training.get("image_size")
+    augment = make_train_augment(size=size)
+    eval_transform = make_eval_transform(size=size)
+
+    # The DDP wrap (reference :245): builds the shard_map'd pmean train step.
+    ddp = DistributedDataParallel(
+        model,
+        optimizer,
+        criterion,
+        mesh=mesh,
+        mode=training.get("mode", "shard_map"),
+        augment=augment,
+        eval_transform=eval_transform,
+    )
+    in_hw = size if size else train_ds.images.shape[1]
+    state = ddp.init_state(key, jnp.zeros((1, in_hw, in_hw, 3)))
+
+    run_training_loop(
+        ddp,
+        state,
+        train_loader,
+        test_loader,
+        save_dir,
+        num_epochs=training["num_epochs"],
+        checkpoint_epoch=training["checkpoint_epoch"],
+        set_epoch=optional_args.get("set_epoch", True),
+        print_rand=optional_args.get("print_rand", False),
+        data_probe_every=100,  # shard-disjointness probe (reference :112-115)
+    )
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="Run script based on local_settings.yaml file.",
+    )
+    parser.add_argument(
+        "--settings_file",
+        type=str,
+        required=True,
+        help="Path to local_settings.yaml file specifying cluster settings and "
+        "other parameters.",
+    )
+    args = parser.parse_args()
+
+    settings = cfg_lib.load_settings(args.settings_file)
+    out_dir = cfg_lib.prepare_out_dir(settings, args.settings_file)
+    world_size = cfg_lib.world_size_from(settings)
+    optional_args = cfg_lib.optional_args_from(settings)
+    training = cfg_lib.training_config(settings)
+
+    run_ddp_training(
+        partial(basic_ddp_training_loop, training=training),
+        world_size,
+        out_dir,
+        optional_args,
+        backend=cfg_lib.device_from(settings),
+    )
