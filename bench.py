@@ -1,0 +1,129 @@
+"""Benchmark: samples/sec/chip on the toy MLP (the BASELINE.json metric).
+
+Workload parity with the reference hot loop (multi-GPU-training-torch.py:109-132):
+per-chip batch 128, Adam lr=1e-3, cross-entropy, CIFAR-shaped 32x32x3 inputs,
+full DP train step (forward, backward, grad pmean, update, on-device metrics).
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+baseline is *measured here*: the same workload run through the reference's
+stack (torch + torch.optim.Adam) on this host's available torch device (CPU in
+this environment — the reference's CUDA path needs NVIDIA hardware that does
+not exist on a TPU host). vs_baseline = tpuddp_samples_per_sec / torch_samples_per_sec.
+
+Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def bench_tpuddp(batch_per_chip=128, steps=200, warmup=20):
+    import jax
+    import jax.numpy as jnp
+
+    from tpuddp import nn, optim
+    from tpuddp.models import ToyMLP
+    from tpuddp.parallel import make_mesh
+    from tpuddp.parallel.ddp import DistributedDataParallel
+
+    devices = jax.devices()
+    mesh = make_mesh(devices)
+    n_chips = len(devices)
+    global_batch = batch_per_chip * n_chips
+    log(f"tpuddp bench: {n_chips} chip(s), global batch {global_batch}")
+
+    model = ToyMLP(num_classes=10)
+    ddp = DistributedDataParallel(
+        model, optim.Adam(1e-3), nn.CrossEntropyLoss(), mesh=mesh, mode="shard_map"
+    )
+    state = ddp.init_state(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(global_batch, 32, 32, 3).astype(np.float32)
+    y = rng.randint(0, 10, global_batch).astype(np.int32)
+    w = np.ones(global_batch, np.float32)
+    batch = ddp.shard((x, y, w))
+
+    for _ in range(warmup):
+        state, metrics = ddp.train_step(state, batch)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = ddp.train_step(state, batch)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+
+    sps = steps * global_batch / dt
+    log(f"tpuddp: {sps:,.0f} samples/s total, {sps / n_chips:,.0f} /chip, {dt:.3f}s")
+    return sps / n_chips, n_chips
+
+
+def bench_torch_cpu(batch=128, steps=30, warmup=3):
+    """The reference stack's hot loop on this host (torch CPU)."""
+    try:
+        import torch
+        import torch.nn as tnn
+    except Exception as e:  # pragma: no cover
+        log(f"torch unavailable ({e}); vs_baseline=1.0")
+        return None
+
+    torch.manual_seed(0)
+    model = tnn.Sequential(
+        tnn.Flatten(),
+        tnn.Linear(32 * 32 * 3, 256),
+        tnn.ReLU(),
+        tnn.Linear(256, 128),
+        tnn.ReLU(),
+        tnn.Linear(128, 10),
+    )
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    criterion = tnn.CrossEntropyLoss()
+    x = torch.randn(batch, 3, 32, 32)
+    y = torch.randint(0, 10, (batch,))
+
+    def step():
+        opt.zero_grad()
+        loss = criterion(model(x), y)
+        loss.backward()
+        opt.step()
+        return float(loss.item())  # the reference's per-batch sync (quirk Q5)
+
+    for _ in range(warmup):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        step()
+    dt = time.perf_counter() - t0
+    sps = steps * batch / dt
+    log(f"torch-cpu baseline: {sps:,.0f} samples/s")
+    return sps
+
+
+def main():
+    ours, n_chips = bench_tpuddp()
+    baseline = bench_torch_cpu()
+    vs = ours / baseline if baseline else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "toy_mlp_train_samples_per_sec_per_chip",
+                "value": round(ours, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
