@@ -1,0 +1,109 @@
+"""Model zoo structure checks — param counts must equal the reference stack's
+torchvision architectures (same topology, NHWC layout)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuddp import nn
+from tpuddp.models import AlexNet, ResNet18, ToyCNN, ToyMLP, load_model
+from tpuddp.models.alexnet import replace_head
+from tpuddp.nn.core import Context
+
+KEY = jax.random.key(0)
+
+
+def n_params(tree):
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(tree))
+
+
+def test_registry():
+    assert isinstance(load_model("toy_mlp"), nn.Sequential)
+    with pytest.raises(ValueError):
+        load_model("vgg")
+
+
+def test_toy_models_forward():
+    x = jnp.zeros((2, 32, 32, 3))
+    for model in (ToyMLP(), ToyCNN()):
+        params, state = model.init(KEY, x)
+        y, _ = model.apply(params, state, x, Context())
+        assert y.shape == (2, 10)
+
+
+# torchvision isn't in this image, so the oracles are the published
+# architecture parameter counts: AlexNet(1000) = 61,100,840 and
+# ResNet-18(1000) = 11,689,512, adjusted for the 10-way head swap the
+# reference performs (data_and_toy_model.py:43-44).
+ALEXNET_10_PARAMS = 61_100_840 - (4096 * 1000 + 1000) + (4096 * 10 + 10)
+RESNET18_10_PARAMS = 11_689_512 - (512 * 1000 + 1000) + (512 * 10 + 10)
+
+
+@pytest.mark.slow
+def test_alexnet_matches_torchvision_param_count():
+    """Same topology as the reference's load_model() output
+    (data_and_toy_model.py:41-45): torchvision AlexNet with a 10-way head."""
+    model = AlexNet(num_classes=10)
+    params, state = model.init(KEY, jnp.zeros((1, 224, 224, 3)))
+    assert n_params(params) == ALEXNET_10_PARAMS
+
+    y, _ = model.apply(params, state, jnp.zeros((2, 224, 224, 3)), Context())
+    assert y.shape == (2, 10)
+
+
+@pytest.mark.slow
+def test_resnet18_matches_torchvision_param_count():
+    # BN running stats are buffers (model_state), not params — like torch.
+    model = ResNet18(num_classes=10)
+    params, state = model.init(KEY, jnp.zeros((1, 64, 64, 3)))
+    assert n_params(params) == RESNET18_10_PARAMS
+
+    y, _ = model.apply(params, state, jnp.zeros((2, 64, 64, 3)), Context())
+    assert y.shape == (2, 10)
+
+
+def test_resnet18_small_input_stem():
+    model = ResNet18(num_classes=10, small_input=True)
+    params, state = model.init(KEY, jnp.zeros((1, 32, 32, 3)))
+    y, new_state = model.apply(
+        params, state, jnp.ones((2, 32, 32, 3)), Context(train=True)
+    )
+    assert y.shape == (2, 10)
+    # BN buffers update in train mode somewhere in the tree
+    leaves_before = jax.tree_util.tree_leaves(state)
+    leaves_after = jax.tree_util.tree_leaves(new_state)
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(leaves_before, leaves_after)
+    )
+
+
+def test_resnet_sync_bn_conversion():
+    model = ResNet18(num_classes=10)
+    nn.convert_sync_batchnorm(model)
+    # stem BN + every block's BNs flipped
+    assert model[1].sync is True
+    block = model[4]
+    assert block.bn1.sync and block.bn2.sync and block.down_bn.sync
+
+
+def test_alexnet_replace_head():
+    model = AlexNet(num_classes=10)
+    params, state = model.init(KEY, jnp.zeros((1, 63, 63, 3)))
+    params = list(params)
+    new_params = replace_head(model, params, jax.random.key(1), num_classes=7)
+    y, _ = model.apply(new_params, state, jnp.zeros((1, 63, 63, 3)), Context())
+    assert y.shape == (1, 7)
+
+
+def test_alexnet_dropout_only_in_train():
+    model = AlexNet(num_classes=10, dropout=0.9)
+    params, state = model.init(KEY, jnp.zeros((1, 63, 63, 3)))
+    x = jnp.ones((1, 63, 63, 3))
+    y1, _ = model.apply(params, state, x, Context(train=False))
+    y2, _ = model.apply(params, state, x, Context(train=False))
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))  # deterministic eval
+    t1, _ = model.apply(params, state, x, Context(train=True, rng=jax.random.key(1)))
+    t2, _ = model.apply(params, state, x, Context(train=True, rng=jax.random.key(2)))
+    assert not np.allclose(np.asarray(t1), np.asarray(t2))  # stochastic train

@@ -1,0 +1,88 @@
+"""Observability subsystems (SURVEY.md §5): metrics JSONL, NaN guard,
+profiler env toggle, and loop resume."""
+
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpuddp import optim
+from tpuddp.data import ShardedDataLoader, SyntheticClassification
+from tpuddp.models import ToyMLP
+from tpuddp.nn import CrossEntropyLoss
+from tpuddp.parallel import make_mesh
+from tpuddp.parallel.ddp import DistributedDataParallel
+from tpuddp.training import checkpoint as ckpt
+from tpuddp.training.loop import run_training_loop
+from tpuddp.utils.observability import MetricsWriter, check_finite
+
+
+def small_run(mesh, save_dir, num_epochs=2, start_epoch=0, state=None):
+    ds = SyntheticClassification(n=64, shape=(8, 8, 3), seed=0)
+    loader = ShardedDataLoader(ds, 8, mesh, shuffle=True)
+    test_loader = ShardedDataLoader(ds, 8, mesh, shuffle=True)
+    ddp = DistributedDataParallel(
+        ToyMLP(hidden=(16,)), optim.Adam(1e-2), CrossEntropyLoss(), mesh=mesh
+    )
+    if state is None:
+        state = ddp.init_state(jax.random.key(0), jnp.zeros((1, 8, 8, 3)))
+    return ddp, run_training_loop(
+        ddp, state, loader, test_loader, save_dir,
+        num_epochs=num_epochs, checkpoint_epoch=1, start_epoch=start_epoch,
+        log=lambda *_: None,
+    )
+
+
+def test_history_jsonl_written(mesh, tmp_path):
+    _, (state, history) = small_run(mesh, str(tmp_path))
+    path = tmp_path / "history.jsonl"
+    assert path.exists()
+    records = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(records) == 2
+    assert records[0]["epoch"] == 0
+    assert {"train_loss", "test_loss", "test_accuracy", "epoch_time_s"} <= set(records[0])
+
+
+def test_checkpoints_every_epoch_and_resume(mesh, tmp_path):
+    ddp, (state, history) = small_run(mesh, str(tmp_path), num_epochs=2)
+    assert os.path.exists(tmp_path / "ckpt_0.npz")
+    assert os.path.exists(tmp_path / "ckpt_1.npz")
+
+    # resume: restore newest, continue for one more epoch
+    template = ddp.init_state(jax.random.key(0), jnp.zeros((1, 8, 8, 3)))
+    restored, start = ckpt.restore_latest(str(tmp_path), template)
+    assert start == 2
+    assert int(restored.step) == int(state.step)
+    _, (state2, history2) = small_run(
+        mesh, str(tmp_path), num_epochs=3, start_epoch=start, state=restored
+    )
+    assert [h["epoch"] for h in history2] == [2]
+    assert os.path.exists(tmp_path / "ckpt_2.npz")
+
+
+def test_check_finite_guard(monkeypatch):
+    check_finite(math.nan, "loss")  # disabled: no raise
+    monkeypatch.setenv("TPUDDP_DEBUG_NANS", "1")
+    check_finite(1.0, "loss")
+    with pytest.raises(FloatingPointError, match="loss"):
+        check_finite(math.nan, "loss")
+    with pytest.raises(FloatingPointError):
+        check_finite(math.inf, "loss")
+
+
+def test_metrics_writer_none_dir_is_noop():
+    w = MetricsWriter(None)
+    w.write({"a": 1})  # no crash, nothing written
+    assert w.path is None
+
+
+def test_profiler_env_toggle(monkeypatch, tmp_path, mesh):
+    monkeypatch.setenv("TPUDDP_PROFILE", str(tmp_path / "trace"))
+    small_run(mesh, str(tmp_path / "run"), num_epochs=1)
+    # a trace directory with at least one artifact was produced
+    trace_dir = tmp_path / "trace"
+    assert trace_dir.exists()
+    assert any(trace_dir.rglob("*"))

@@ -1,0 +1,45 @@
+"""Launcher contracts (SURVEY.md §2b #14): worker signature, exception
+propagation (mp.spawn join=True analog), re-exec no-op conditions."""
+
+import pytest
+
+from tpuddp.parallel import backend
+from tpuddp.parallel.spawn import maybe_reexec_for_world, run_ddp_training
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    backend.cleanup()
+    yield
+    backend.cleanup()
+
+
+def test_worker_called_with_rank_world_save_args(tmp_path):
+    calls = []
+
+    def worker(rank, world_size, save_dir, optional_args):
+        calls.append((rank, world_size, save_dir, optional_args))
+
+    run_ddp_training(worker, 4, str(tmp_path), {"set_epoch": True}, backend="cpu")
+    assert calls == [(0, 4, str(tmp_path), {"set_epoch": True})]
+    assert not backend.is_initialized()  # cleanup ran
+
+
+def test_worker_exception_propagates(tmp_path):
+    def worker(rank, world_size, save_dir, optional_args):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        run_ddp_training(worker, 2, str(tmp_path), {}, backend="cpu")
+    assert not backend.is_initialized()  # cleanup still ran (join=True contract)
+
+
+def test_reexec_noop_when_devices_sufficient():
+    # 8 virtual CPU devices exist in the test world: must not exec
+    maybe_reexec_for_world(8, "cpu")
+
+
+def test_reexec_guard_detects_failed_expansion(monkeypatch):
+    monkeypatch.setenv("TPUDDP_SPAWNED", "1")
+    with pytest.raises(RuntimeError, match="re-exec"):
+        maybe_reexec_for_world(4096, "cpu")

@@ -27,6 +27,12 @@ from tpuddp import seeding
 from tpuddp.parallel import collectives as col
 from tpuddp.training import checkpoint as ckpt
 from tpuddp.training.step import accumulate_metrics, finalize_metrics
+from tpuddp.utils.observability import (
+    MetricsWriter,
+    check_finite,
+    maybe_start_profiler,
+    stop_profiler,
+)
 
 logger = logging.getLogger("tpuddp")
 
@@ -54,6 +60,8 @@ def run_training_loop(
     """
     is_main = jax.process_index() == 0
     history = []
+    metrics_writer = MetricsWriter(save_dir)
+    profiling = maybe_start_profiler(save_dir)  # $TPUDDP_PROFILE hook
 
     if is_main:
         log(
@@ -116,6 +124,12 @@ def run_training_loop(
             "epoch_time_s": epoch_time,
         }
         history.append(record)
+        metrics_writer.write(record)
+        check_finite(train_loss, "train loss")  # $TPUDDP_DEBUG_NANS guard
+
+        if profiling and epoch == start_epoch:
+            stop_profiler()  # trace the first epoch only
+            profiling = False
 
         if is_main:
             # Exact reference log format (:209-215).

@@ -1,0 +1,10 @@
+"""Utility subsystems: observability (tracing/profiling/metrics), debugging."""
+
+from tpuddp.utils.observability import (  # noqa: F401
+    MetricsWriter,
+    check_finite,
+    maybe_start_profiler,
+    stop_profiler,
+)
+
+__all__ = ["MetricsWriter", "check_finite", "maybe_start_profiler", "stop_profiler"]

@@ -1,0 +1,78 @@
+"""Observability — the auxiliary subsystems the reference only gestures at
+(SURVEY.md §5):
+
+- **Tracing/profiling**: the reference's only hook is a commented-out
+  ``NCCL_DEBUG=INFO`` env knob (multi-GPU-training-torch.py:8-10). tpuddp's
+  analog is env-toggled XLA profiling: ``TPUDDP_PROFILE=<dir>`` starts a
+  ``jax.profiler`` trace (viewable in TensorBoard/XProf, captures HLO +
+  TPU step events) for the first epoch.
+- **NaN detection**: ``TPUDDP_DEBUG_NANS=1`` makes the epoch driver raise on
+  non-finite aggregated losses (the "race detection / sanitizer" row of
+  SURVEY.md §5 — JAX's functional purity removes data races; numerical blowup
+  is the failure mode worth a guard).
+- **Metrics**: per-epoch JSONL history written by process 0 next to the
+  checkpoints, replacing grep-able stdout as the machine-readable record
+  (condor .out parsing in the reference, submit_job.py:36-38).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Optional
+
+import jax
+
+_PROFILE_ENV = "TPUDDP_PROFILE"
+_NANS_ENV = "TPUDDP_DEBUG_NANS"
+_profiling = {"active": False}
+
+
+def maybe_start_profiler(default_dir: Optional[str] = None) -> bool:
+    """Start an XLA trace if $TPUDDP_PROFILE is set (its value is the trace
+    dir; '1' falls back to ``default_dir``/trace). Returns True if started."""
+    target = os.environ.get(_PROFILE_ENV)
+    if not target or _profiling["active"]:
+        return False
+    if target == "1":
+        if default_dir is None:
+            return False
+        target = os.path.join(default_dir, "trace")
+    os.makedirs(target, exist_ok=True)
+    jax.profiler.start_trace(target)
+    _profiling["active"] = True
+    return True
+
+
+def stop_profiler() -> None:
+    if _profiling["active"]:
+        jax.profiler.stop_trace()
+        _profiling["active"] = False
+
+
+def nan_checks_enabled() -> bool:
+    return os.environ.get(_NANS_ENV, "") not in ("", "0")
+
+
+def check_finite(value: float, what: str) -> None:
+    """Raise if a host-side aggregated metric went non-finite (only when
+    $TPUDDP_DEBUG_NANS is set)."""
+    if nan_checks_enabled() and not math.isfinite(value):
+        raise FloatingPointError(f"non-finite {what}: {value}")
+
+
+class MetricsWriter:
+    """Process-0 JSONL metrics sink (``history.jsonl`` in the run dir)."""
+
+    def __init__(self, save_dir: Optional[str], filename: str = "history.jsonl"):
+        self.path = None
+        if save_dir is not None and jax.process_index() == 0:
+            os.makedirs(save_dir, exist_ok=True)
+            self.path = os.path.join(save_dir, filename)
+
+    def write(self, record: dict) -> None:
+        if self.path is None:
+            return
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record) + "\n")

@@ -86,6 +86,16 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
     in_hw = size if size else train_ds.images.shape[1]
     state = ddp.init_state(key, jnp.zeros((1, in_hw, in_hw, 3)))
 
+    # Resume path (the reference only documents loading, README.md:51-52):
+    # training.resume: true restores the newest ckpt_{epoch}.npz in out_dir.
+    start_epoch = 0
+    if training.get("resume"):
+        from tpuddp.training import checkpoint as ckpt
+
+        state, start_epoch = ckpt.restore_latest(save_dir, state)
+        if start_epoch:
+            print(f"Resumed from epoch {start_epoch - 1} checkpoint.")
+
     run_training_loop(
         ddp,
         state,
@@ -97,6 +107,7 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         set_epoch=optional_args.get("set_epoch", True),
         print_rand=optional_args.get("print_rand", False),
         data_probe_every=100,  # shard-disjointness probe (reference :112-115)
+        start_epoch=start_epoch,
     )
 
 
