@@ -1,0 +1,79 @@
+"""Multi-step scan training: K fused steps must be semantically identical to
+K sequential single steps (params, buffers, metrics, RNG schedule)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuddp import optim
+from tpuddp.data import SyntheticClassification
+from tpuddp.models import ToyCNN, ToyMLP
+from tpuddp.nn import CrossEntropyLoss
+from tpuddp.parallel import make_mesh
+from tpuddp.parallel.ddp import DistributedDataParallel
+from tpuddp.training.step import stack_batches
+
+KEY = jax.random.key(7)
+
+
+def make_batches(k, n=32, shape=(8, 8, 3), seed=0):
+    ds = SyntheticClassification(n=n * k, shape=shape, seed=seed)
+    return [
+        (
+            ds.images[i * n : (i + 1) * n],
+            ds.labels[i * n : (i + 1) * n],
+            np.ones(n, np.float32),
+        )
+        for i in range(k)
+    ]
+
+
+@pytest.mark.parametrize("mode", ["shard_map", "auto"])
+@pytest.mark.parametrize("model_fn", [ToyMLP, lambda: ToyCNN(sync_bn=True)])
+def test_scan_equals_sequential(cpu_devices, mode, model_fn):
+    mesh = make_mesh(cpu_devices)
+    batches = make_batches(4)
+
+    def fresh():
+        ddp = DistributedDataParallel(
+            model_fn(), optim.Adam(1e-2), CrossEntropyLoss(), mesh=mesh, mode=mode
+        )
+        return ddp, ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+
+    # sequential
+    ddp_a, state_a = fresh()
+    total_a = None
+    for b in batches:
+        state_a, m = ddp_a.train_step(state_a, ddp_a.shard(b))
+        total_a = m if total_a is None else jax.tree_util.tree_map(
+            jnp.add, total_a, m
+        )
+
+    # fused scan
+    ddp_b, state_b = fresh()
+    stacked = ddp_b.shard_stacked(stack_batches(batches))
+    state_b, total_b = ddp_b.train_step_many(state_b, stacked)
+
+    assert int(state_b.step) == int(state_a.step) == 4
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5
+        ),
+        state_a.params,
+        state_b.params,
+    )
+    np.testing.assert_allclose(
+        np.sum(np.asarray(total_a["loss_sum"])),
+        np.sum(np.asarray(total_b["loss_sum"])),
+        rtol=1e-4,
+    )
+    assert float(np.sum(np.asarray(total_b["n"]))) == 4 * 32
+
+
+def test_stack_batches_shapes():
+    batches = make_batches(3, n=8, shape=(4,))
+    xs, ys, ws = stack_batches(batches)
+    assert xs.shape == (3, 8, 4)
+    assert ys.shape == (3, 8)
+    assert ws.shape == (3, 8)

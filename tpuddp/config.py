@@ -34,6 +34,7 @@ TRAINING_DEFAULTS = {
     "seed": None,  # None -> fresh per run, like torch initial_seed
     "mode": "shard_map",
     "sync_bn": False,
+    "scan_steps": 1,  # >1 fuses K train steps per dispatch (lax.scan)
 }
 
 

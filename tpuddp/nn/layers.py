@@ -48,9 +48,11 @@ class Linear(Module):
         return params, ()
 
     def apply(self, params, state, x, ctx: Context):
-        y = x @ params["weight"]
+        # params stay f32 masters; compute follows the activation dtype so a
+        # bf16 pipeline runs the matmul on the MXU in bf16 (mixed precision)
+        y = x @ params["weight"].astype(x.dtype)
         if self.use_bias:
-            y = y + params["bias"]
+            y = y + params["bias"].astype(x.dtype)
         return y, state
 
 
@@ -169,6 +171,8 @@ class AdaptiveAvgPool2d(Module):
     def apply(self, params, state, x, ctx: Context):
         n, h, w, c = x.shape
         oh, ow = self.output_size
+        in_dtype = x.dtype
+        x = x.astype(jnp.float32)  # integral-image sums need f32 accumulation
         # integral image with a leading zero row/col: I[i, j] = sum(x[:i, :j])
         ii = jnp.cumsum(jnp.cumsum(x, axis=1), axis=2)
         ii = jnp.pad(ii, ((0, 0), (1, 0), (1, 0), (0, 0)))
@@ -180,8 +184,8 @@ class AdaptiveAvgPool2d(Module):
         c_ = ii[:, hs[:, None], we[None, :], :]
         d = ii[:, hs[:, None], ws[None, :], :]
         sums = a - b - c_ + d
-        areas = ((he - hs)[:, None] * (we - ws)[None, :]).astype(x.dtype)
-        return sums / areas[None, :, :, None], state
+        areas = ((he - hs)[:, None] * (we - ws)[None, :]).astype(jnp.float32)
+        return (sums / areas[None, :, :, None]).astype(in_dtype), state
 
 
 class ReLU(Module):

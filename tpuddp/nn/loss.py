@@ -24,6 +24,7 @@ def cross_entropy(
     reduction: 'mean' (weighted mean), 'sum', or 'none'.
     weights: optional per-sample weights/mask (N,).
     """
+    logits = logits.astype(jnp.float32)  # stable softmax even for bf16 nets
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     true_logit = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
     losses = logz - true_logit

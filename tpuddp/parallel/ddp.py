@@ -55,6 +55,7 @@ class DistributedDataParallel:
         self.eval_transform = eval_transform
         self._train_step = None
         self._eval_step = None
+        self._scan_step = None
 
     # -- world introspection (dist.get_world_size analog) -------------------
     @property
@@ -72,6 +73,37 @@ class DistributedDataParallel:
     def shard(self, batch):
         """Place a host batch onto the mesh, split over the data axis."""
         return shard_batch(self.mesh, batch)
+
+    def shard_stacked(self, stacked_batch):
+        """Place a (K, batch, ...) super-batch for the scan step: axis 1 is the
+        data axis, axis 0 the step axis."""
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def _put(x):
+            spec = P(None, "data", *([None] * (x.ndim - 2)))
+            sharding = NamedSharding(self.mesh, spec)
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+            return jax.device_put(x, sharding)
+
+        return jax.tree_util.tree_map(_put, stacked_batch)
+
+    def train_step_many(self, state: TrainState, stacked_batch):
+        """K fused train steps per dispatch (lax.scan; see
+        training.step.build_train_scan_step)."""
+        if self._scan_step is None:
+            self._scan_step = step_lib.build_train_scan_step(
+                self.model,
+                self.criterion,
+                self.optimizer,
+                self.mesh,
+                mode=self.mode,
+                sync_buffers=self.sync_buffers,
+                clip_grad_norm=self.clip_grad_norm,
+                augment=self.augment,
+            )
+        return self._scan_step(state, stacked_batch)
 
     def train_step(self, state: TrainState, batch):
         if self._train_step is None:

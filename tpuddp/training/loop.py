@@ -26,7 +26,7 @@ import jax
 from tpuddp import seeding
 from tpuddp.parallel import collectives as col
 from tpuddp.training import checkpoint as ckpt
-from tpuddp.training.step import accumulate_metrics, finalize_metrics
+from tpuddp.training.step import accumulate_metrics, finalize_metrics, stack_batches
 from tpuddp.utils.observability import (
     MetricsWriter,
     check_finite,
@@ -49,6 +49,7 @@ def run_training_loop(
     print_rand: bool = False,
     data_probe_every: Optional[int] = None,
     start_epoch: int = 0,
+    scan_steps: int = 1,
     log=print,
 ):
     """Run the full training loop; returns ``(state, history)`` where history
@@ -83,18 +84,28 @@ def run_training_loop(
         if print_rand:
             log(f"Process {jax.process_index()}, {seeding.rng_probe_string()}")
 
-        # ---- train pass (hot loop: one jitted step per batch) ----
+        # ---- train pass (hot loop: one jitted step per batch, or per
+        # `scan_steps` batches fused into a single lax.scan dispatch) ----
         train_acc = None
-        n_train_samples = 0
+        chunk = []
         for batch_idx, host_batch in enumerate(train_loader):
             if data_probe_every and batch_idx % data_probe_every == 0:
                 probe = getattr(train_loader, "probe_fingerprint", None)
                 if probe is not None:
                     log(f"TRAIN: Batch {batch_idx}, Data {probe(host_batch[0])}")
-            batch = ddp.shard(host_batch)
-            state, metrics = ddp.train_step(state, batch)
+            if scan_steps <= 1:
+                state, metrics = ddp.train_step(state, ddp.shard(host_batch))
+                train_acc = accumulate_metrics(train_acc, metrics)
+                continue
+            chunk.append(host_batch)
+            if len(chunk) == scan_steps:
+                stacked = ddp.shard_stacked(stack_batches(chunk))
+                state, metrics = ddp.train_step_many(state, stacked)
+                train_acc = accumulate_metrics(train_acc, metrics)
+                chunk = []
+        for host_batch in chunk:  # remainder: single steps, same semantics
+            state, metrics = ddp.train_step(state, ddp.shard(host_batch))
             train_acc = accumulate_metrics(train_acc, metrics)
-            n_train_samples += len(host_batch[1])
 
         # ---- eval pass ----
         eval_acc = None

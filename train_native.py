@@ -108,6 +108,7 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         print_rand=optional_args.get("print_rand", False),
         data_probe_every=100,  # shard-disjointness probe (reference :112-115)
         start_epoch=start_epoch,
+        scan_steps=int(training.get("scan_steps", 1)),
     )
 
 
