@@ -1,0 +1,5 @@
+"""Custom TPU ops (Pallas kernels) with XLA fallbacks."""
+
+from tpuddp.ops.fused_adam import FusedAdam, fused_adam_update  # noqa: F401
+
+__all__ = ["FusedAdam", "fused_adam_update"]
