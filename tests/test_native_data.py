@@ -1,0 +1,111 @@
+"""Native C++ data path (tpuddp/data/_native) and the prefetching loader —
+both must be bit-identical to the numpy fallback."""
+
+import numpy as np
+import pytest
+
+from tpuddp.data import DataLoader, PrefetchLoader, ShardedDataLoader, SyntheticClassification
+from tpuddp.data import _native
+from tpuddp.data.loader import _fetch_padded
+from tpuddp.parallel import make_mesh
+
+
+needs_native = pytest.mark.skipif(
+    not _native.available(), reason="native gather library unavailable (no g++?)"
+)
+
+
+@needs_native
+@pytest.mark.parametrize("dtype", [np.uint8, np.float32])
+def test_native_gather_matches_numpy(dtype):
+    rng = np.random.RandomState(0)
+    src = np.ascontiguousarray(
+        (rng.rand(100, 7, 5) * 200).astype(dtype)
+    )
+    idx = rng.randint(0, 100, 33)
+    out = _native.gather_rows(src, idx)
+    np.testing.assert_array_equal(out, src[idx])
+
+
+@needs_native
+def test_native_gather_padding_repeats_first_row():
+    src = np.arange(40, dtype=np.uint8).reshape(10, 4)
+    out = _native.gather_rows(src, np.array([3, 7]), pad_rows=5)
+    assert out.shape == (5, 4)
+    np.testing.assert_array_equal(out[0], src[3])
+    np.testing.assert_array_equal(out[1], src[7])
+    for i in (2, 3, 4):
+        np.testing.assert_array_equal(out[i], src[3])
+
+
+@needs_native
+def test_native_gather_large_batch_multithreaded():
+    rng = np.random.RandomState(1)
+    src = np.ascontiguousarray(rng.randint(0, 255, (5000, 3072), dtype=np.uint8))
+    idx = rng.randint(0, 5000, 2048)
+    out = _native.gather_rows(src, idx)
+    np.testing.assert_array_equal(out, src[idx])
+
+
+def test_native_gather_rejects_noncontiguous():
+    src = np.zeros((10, 8), np.uint8)[:, ::2]
+    assert _native.gather_rows(src, np.array([0, 1])) is None
+
+
+def test_fetch_padded_native_equals_fallback(monkeypatch):
+    ds = SyntheticClassification(n=50, shape=(6, 6, 3), seed=2)
+    idx = np.array([4, 9, 11])
+    got = _fetch_padded(ds, idx, 8)
+    # force the numpy fallback
+    monkeypatch.setattr(_native, "gather_rows", lambda *a, **k: None)
+    want = _fetch_padded(ds, idx, 8)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_prefetch_loader_yields_identical_batches(cpu_devices):
+    mesh = make_mesh(cpu_devices[:4])
+    ds = SyntheticClassification(n=64, shape=(4, 4, 3), seed=3)
+    base = ShardedDataLoader(ds, 4, mesh, shuffle=True, seed=1)
+    pre = PrefetchLoader(ShardedDataLoader(ds, 4, mesh, shuffle=True, seed=1))
+    assert len(pre) == len(base)
+    for epoch in range(2):
+        base.set_epoch(epoch)
+        pre.set_epoch(epoch)
+        for (xa, ya, wa), (xb, yb, wb) in zip(base, pre):
+            np.testing.assert_array_equal(xa, xb)
+            np.testing.assert_array_equal(ya, yb)
+            np.testing.assert_array_equal(wa, wb)
+
+
+def test_prefetch_loader_delegates_probe(cpu_devices):
+    mesh = make_mesh(cpu_devices[:2])
+    ds = SyntheticClassification(n=16, shape=(8,), seed=0)
+    pre = PrefetchLoader(ShardedDataLoader(ds, 4, mesh, shuffle=False))
+    x, _, _ = next(iter(pre))
+    assert "replica 0" in pre.probe_fingerprint(x)
+    assert pre.world_size == 2  # __getattr__ delegation
+
+
+def test_prefetch_loader_propagates_exceptions():
+    class Exploding:
+        def __iter__(self):
+            yield (np.zeros(1), np.zeros(1), np.zeros(1))
+            raise RuntimeError("loader blew up")
+
+        def __len__(self):
+            return 2
+
+    pre = PrefetchLoader(Exploding())
+    it = iter(pre)
+    next(it)
+    with pytest.raises(RuntimeError, match="loader blew up"):
+        list(it)
+
+
+def test_prefetch_wraps_plain_dataloader():
+    ds = SyntheticClassification(n=20, shape=(4,), seed=1)
+    pre = PrefetchLoader(DataLoader(ds, batch_size=8))
+    batches = list(pre)
+    assert len(batches) == 3
+    assert batches[-1][2].sum() == 4  # padding mask intact through the queue

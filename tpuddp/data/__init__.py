@@ -1,6 +1,15 @@
 """Data layer: datasets, host loaders, and device-side transforms."""
 
-from tpuddp.data.loader import DataLoader, ShardedDataLoader  # noqa: F401
+from tpuddp.data.loader import (  # noqa: F401
+    DataLoader,
+    PrefetchLoader,
+    ShardedDataLoader,
+)
 from tpuddp.data.synthetic import SyntheticClassification  # noqa: F401
 
-__all__ = ["DataLoader", "ShardedDataLoader", "SyntheticClassification"]
+__all__ = [
+    "DataLoader",
+    "PrefetchLoader",
+    "ShardedDataLoader",
+    "SyntheticClassification",
+]

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 
 from tpuddp import config as cfg_lib
 from tpuddp import nn, optim, seeding
-from tpuddp.data import ShardedDataLoader
+from tpuddp.data import PrefetchLoader, ShardedDataLoader
 from tpuddp.data.cifar10 import load_datasets
 from tpuddp.data.transforms import make_eval_transform, make_train_augment
 from tpuddp.models import load_model
@@ -59,6 +59,11 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
     test_loader = ShardedDataLoader(
         test_ds, training["test_batch_size"], mesh, shuffle=True
     )
+    if training.get("prefetch", True):
+        # overlap host batch assembly with device compute (the reference's
+        # num_workers=2 analog, multi-GPU-training-torch.py:90-98)
+        train_loader = PrefetchLoader(train_loader)
+        test_loader = PrefetchLoader(test_loader)
 
     model = load_model(training["model"])
     if training.get("sync_bn"):
