@@ -1,0 +1,74 @@
+"""Worker for the multi-process DP test (launched by test_multihost.py).
+
+Each OS process = one "host" with 4 virtual CPU devices; jax.distributed
+rendezvous glues them into one 8-device world. Exercises the full multi-host
+path: global mesh over both processes' devices, per-process shard loading,
+cross-process grad pmean, sync_global_devices barriers, process-0-only
+logging/checkpointing, broadcast_one_to_all at init.
+
+Usage: python _multihost_worker.py <proc_id> <nprocs> <coord_port> <out_dir>
+"""
+
+import json
+import sys
+
+proc_id, nprocs, port, out_dir = (
+    int(sys.argv[1]),
+    int(sys.argv[2]),
+    sys.argv[3],
+    sys.argv[4],
+)
+
+import jax  # noqa: E402
+
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=nprocs,
+    process_id=proc_id,
+)
+
+import jax.numpy as jnp  # noqa: E402
+
+from tpuddp import nn, optim  # noqa: E402
+from tpuddp.data import ShardedDataLoader, SyntheticClassification  # noqa: E402
+from tpuddp.models import ToyCNN  # noqa: E402
+from tpuddp.parallel import make_mesh  # noqa: E402
+from tpuddp.parallel.ddp import DistributedDataParallel  # noqa: E402
+from tpuddp.training.loop import run_training_loop  # noqa: E402
+
+devices = jax.devices("cpu")
+assert len(devices) == 8, f"expected 8 global cpu devices, got {len(devices)}"
+assert jax.process_count() == nprocs
+
+mesh = make_mesh(devices)
+ds = SyntheticClassification(n=128, shape=(8, 8, 3), seed=11)
+train_loader = ShardedDataLoader(ds, 4, mesh, shuffle=True, seed=0)
+test_loader = ShardedDataLoader(ds, 4, mesh, shuffle=True, seed=0)
+local = train_loader.local_ranks
+assert len(local) == 4, local
+
+ddp = DistributedDataParallel(
+    ToyCNN(widths=(8,), sync_bn=True),
+    optim.Adam(1e-2),
+    nn.CrossEntropyLoss(),
+    mesh=mesh,
+)
+state = ddp.init_state(jax.random.key(0), jnp.zeros((1, 8, 8, 3)))
+state, history = run_training_loop(
+    ddp, state, train_loader, test_loader, out_dir,
+    num_epochs=2, checkpoint_epoch=1,
+)
+
+print(
+    "WORKER_RESULT "
+    + json.dumps(
+        {
+            "proc": proc_id,
+            "local_ranks": local,
+            "train_loss": [round(h["train_loss"], 6) for h in history],
+            "n": [h["train_samples"] for h in history],
+        }
+    ),
+    flush=True,
+)
+jax.distributed.shutdown()

@@ -1,0 +1,74 @@
+"""Multi-process DP — the multi-host contract (SURVEY.md §2c: the one place
+the build exceeds the reference's single-node scope). Two OS processes with 4
+virtual CPU devices each rendezvous via jax.distributed into one 8-device
+world and train together."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_dp_world(tmp_path):
+    port = free_port()
+    env = dict(os.environ)
+    # clean CPU-only children: no TPU plugin, 4 host devices each
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["TPUDDP_BACKEND"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "_multihost_worker.py"),
+             str(i), "2", str(port), str(tmp_path)],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        assert p.returncode == 0, f"worker failed:\n{out[-2000:]}\n{err[-3000:]}"
+        outs.append(out)
+
+    results = []
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("WORKER_RESULT ")][0]
+        results.append(json.loads(line[len("WORKER_RESULT "):]))
+    results.sort(key=lambda r: r["proc"])
+
+    # each process owns a disjoint half of the 8 global replicas, mesh order
+    assert results[0]["local_ranks"] == [0, 1, 2, 3]
+    assert results[1]["local_ranks"] == [4, 5, 6, 7]
+
+    # both processes computed IDENTICAL global metrics (the psum contract)
+    np.testing.assert_allclose(
+        results[0]["train_loss"], results[1]["train_loss"], rtol=1e-6
+    )
+    assert results[0]["n"] == results[1]["n"] == [128.0, 128.0]
+
+    # process 0 only wrote the checkpoints; the loop's epoch log printed once
+    assert os.path.exists(tmp_path / "ckpt_0.npz")
+    assert os.path.exists(tmp_path / "ckpt_1.npz")
+    epoch_lines_0 = [l for l in outs[0].splitlines() if l.startswith("Epoch ")]
+    epoch_lines_1 = [l for l in outs[1].splitlines() if l.startswith("Epoch ")]
+    assert len(epoch_lines_0) == 2  # process 0 logs
+    assert len(epoch_lines_1) == 0  # process 1 gated

@@ -42,7 +42,7 @@ from tpuddp import seeding
 from tpuddp.data.loader import DataLoader, ShardedDataLoader
 from tpuddp.nn.core import Context, Module
 from tpuddp.parallel import collectives as col
-from tpuddp.parallel.mesh import data_mesh, replicated, shard_batch
+from tpuddp.parallel.mesh import data_mesh, replicate, shard_batch
 from tpuddp.training import checkpoint as ckpt
 
 
@@ -133,9 +133,9 @@ class PreparedModel:
         sample = jax.ShapeDtypeStruct((1,) + tuple(np.shape(x))[1:], jnp.asarray(x[:1]).dtype)
         params, mstate = self.module.init(key, sample)
         params, mstate = col.broadcast_one_to_all((params, mstate))
-        sharding = replicated(self.accelerator.mesh)
-        self.params = jax.device_put(params, sharding)
-        self.model_state = jax.device_put(mstate, sharding)
+        self.params, self.model_state = replicate(
+            self.accelerator.mesh, (params, mstate)
+        )
 
     def __call__(self, x) -> LazyForward:
         self._ensure_init(x)
@@ -156,7 +156,7 @@ class PreparedModel:
 
             self._fwd[key] = jax.jit(fwd)
         rng = self.accelerator._next_key() if train else jax.random.key(0)
-        xr = jax.device_put(jnp.asarray(x), replicated(self.accelerator.mesh))
+        xr = replicate(self.accelerator.mesh, jnp.asarray(x))
         return self._fwd[key](self.params, self.model_state, xr, rng)
 
     def _get_grad_step(self, criterion):

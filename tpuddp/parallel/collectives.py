@@ -124,10 +124,24 @@ def broadcast_one_to_all(pytree, is_source: Optional[bool] = None):
     """Host-level broadcast of a pytree from process 0 to all processes —
     the multi-host analog of DDP's construction-time parameter broadcast.
     Single-process: identity (params are already one copy shared by all chips).
+    Typed PRNG-key leaves are transported as their raw key data (the broadcast
+    goes through numpy, which cannot hold key dtypes).
     """
     if jax.process_count() == 1:
         return pytree
-    return multihost_utils.broadcast_one_to_all(pytree, is_source=is_source)
+    leaves, treedef = jax.tree_util.tree_flatten(pytree)
+    is_key = [
+        hasattr(l, "dtype") and jax.dtypes.issubdtype(l.dtype, jax.dtypes.prng_key)
+        for l in leaves
+    ]
+    prepped = [
+        jax.random.key_data(l) if k else l for l, k in zip(leaves, is_key)
+    ]
+    out = multihost_utils.broadcast_one_to_all(prepped, is_source=is_source)
+    restored = [
+        jax.random.wrap_key_data(o) if k else o for o, k in zip(out, is_key)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, restored)
 
 
 def host_sum(x):

@@ -19,7 +19,7 @@ import jax
 from tpuddp.nn.core import Context
 from tpuddp.nn.loss import CrossEntropyLoss
 from tpuddp.parallel import collectives as col
-from tpuddp.parallel.mesh import data_mesh, replicated, shard_batch
+from tpuddp.parallel.mesh import data_mesh, replicate, shard_batch
 from tpuddp.training import step as step_lib
 from tpuddp.training.train_state import TrainState, create_train_state
 
@@ -68,7 +68,7 @@ class DistributedDataParallel:
         the DDP construction contract."""
         state = create_train_state(self.model, self.optimizer, key, sample_input)
         state = col.broadcast_one_to_all(state)
-        return jax.device_put(state, replicated(self.mesh))
+        return replicate(self.mesh, state)
 
     def shard(self, batch):
         """Place a host batch onto the mesh, split over the data axis."""

@@ -62,6 +62,14 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def replicate(mesh: Mesh, tree):
+    """Place a pytree replicated on every mesh device. Works multi-process
+    (where a plain device_put cannot target non-addressable devices): a jitted
+    identity with replicated out_shardings lets each process contribute its
+    (identical — broadcast first!) local copy to the global array."""
+    return jax.jit(lambda t: t, out_shardings=NamedSharding(mesh, P()))(tree)
+
+
 def data_sharded(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     """Sharding for a batch: leading axis split over the "data" mesh axis."""
     spec = P(DATA_AXIS, *([None] * (ndim - 1))) if ndim > 1 else P(DATA_AXIS)
