@@ -35,6 +35,8 @@ TRAINING_DEFAULTS = {
     "mode": "shard_map",
     "sync_bn": False,
     "scan_steps": 1,  # >1 fuses K train steps per dispatch (lax.scan)
+    "remat": False,  # jax.checkpoint: recompute activations in backward
+    "prefetch": True,  # background-thread host batch prefetch
 }
 
 

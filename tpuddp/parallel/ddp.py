@@ -43,6 +43,7 @@ class DistributedDataParallel:
         clip_grad_norm: Optional[float] = None,
         augment: Optional[Callable] = None,
         eval_transform: Optional[Callable] = None,
+        remat: bool = False,
     ):
         self.model = model
         self.optimizer = optimizer
@@ -53,6 +54,7 @@ class DistributedDataParallel:
         self.clip_grad_norm = clip_grad_norm
         self.augment = augment
         self.eval_transform = eval_transform
+        self.remat = remat
         self._train_step = None
         self._eval_step = None
         self._scan_step = None
@@ -102,6 +104,7 @@ class DistributedDataParallel:
                 sync_buffers=self.sync_buffers,
                 clip_grad_norm=self.clip_grad_norm,
                 augment=self.augment,
+                remat=self.remat,
             )
         return self._scan_step(state, stacked_batch)
 
@@ -116,6 +119,7 @@ class DistributedDataParallel:
                 sync_buffers=self.sync_buffers,
                 clip_grad_norm=self.clip_grad_norm,
                 augment=self.augment,
+                remat=self.remat,
             )
         return self._train_step(state, batch)
 

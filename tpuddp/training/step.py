@@ -61,7 +61,19 @@ def _make_train_core(
     sync_buffers: str,
     clip_grad_norm: Optional[float],
     augment: Optional[Callable],
+    remat: bool = False,
 ):
+    # Rematerialization: trade FLOPs for HBM by recomputing activations in the
+    # backward pass (jax.checkpoint) — how large models/batches fit on-chip.
+    apply_fn = model.apply
+    if remat:
+        def apply_fn(params, mstate, x, ctx):  # noqa: F811
+            fn = jax.checkpoint(
+                lambda p, s, v: model.apply(p, s, v, ctx),
+                static_argnums=(),
+            )
+            return fn(params, mstate, x)
+
     def core(state: TrainState, x, y, w):
         aug_rng, dropout_rng = _split_step_rng(state, axis_name)
         if augment is not None:
@@ -69,7 +81,7 @@ def _make_train_core(
 
         def loss_fn(params):
             ctx = Context(train=True, rng=dropout_rng, axis_name=axis_name)
-            logits, model_state = model.apply(params, state.model_state, x, ctx)
+            logits, model_state = apply_fn(params, state.model_state, x, ctx)
             loss = criterion(logits, y, w)
             return loss, model_state
 
@@ -138,12 +150,14 @@ def build_train_step(
     sync_buffers: str = "broadcast",
     clip_grad_norm: Optional[float] = None,
     augment: Optional[Callable] = None,
+    remat: bool = False,
 ):
     """Compile the DP train step over ``mesh``. Returns
     ``step(state, (x, y, w)) -> (new_state, metrics)`` with donated state."""
     if mode == "shard_map":
         core = _make_train_core(
-            model, criterion, optimizer, DATA_AXIS, sync_buffers, clip_grad_norm, augment
+            model, criterion, optimizer, DATA_AXIS, sync_buffers,
+            clip_grad_norm, augment, remat,
         )
         fn = jax.shard_map(
             core,
@@ -155,7 +169,8 @@ def build_train_step(
         jitted = jax.jit(fn, donate_argnums=0)
     elif mode == "auto":
         core = _make_train_core(
-            model, criterion, optimizer, None, sync_buffers, clip_grad_norm, augment
+            model, criterion, optimizer, None, sync_buffers,
+            clip_grad_norm, augment, remat,
         )
         jitted = jax.jit(
             core,
@@ -182,6 +197,7 @@ def build_train_scan_step(
     sync_buffers: str = "broadcast",
     clip_grad_norm: Optional[float] = None,
     augment: Optional[Callable] = None,
+    remat: bool = False,
 ):
     """Multi-step variant: runs K train steps per jit call via ``lax.scan``.
 
@@ -202,7 +218,8 @@ def build_train_scan_step(
         raise ValueError(f"unknown mode {mode!r}; one of 'shard_map', 'auto'")
 
     core = _make_train_core(
-        model, criterion, optimizer, axis_name, sync_buffers, clip_grad_norm, augment
+        model, criterion, optimizer, axis_name, sync_buffers,
+        clip_grad_norm, augment, remat,
     )
 
     def multi(state: TrainState, xs, ys, ws):

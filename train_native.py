@@ -87,6 +87,7 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         mode=training.get("mode", "shard_map"),
         augment=augment,
         eval_transform=eval_transform,
+        remat=bool(training.get("remat", False)),
     )
     in_hw = size if size else train_ds.images.shape[1]
     state = ddp.init_state(key, jnp.zeros((1, in_hw, in_hw, 3)))
