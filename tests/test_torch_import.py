@@ -1,0 +1,91 @@
+"""Torch AlexNet weight import — the imported tpuddp model must produce the
+SAME logits as the torch model (proves end-to-end architecture identity with
+the reference's load_model(), data_and_toy_model.py:41-45)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn as tnn
+
+from tpuddp.models import AlexNet
+from tpuddp.models.torch_import import convert_alexnet_state_dict, load_torch_alexnet
+from tpuddp.nn.core import Context
+
+
+def torch_alexnet(num_classes=10):
+    """torchvision AlexNet topology rebuilt in plain torch (torchvision isn't
+    in this image), with torchvision's exact state_dict key layout."""
+    features = tnn.Sequential(
+        tnn.Conv2d(3, 64, 11, stride=4, padding=2), tnn.ReLU(inplace=True),
+        tnn.MaxPool2d(3, 2),
+        tnn.Conv2d(64, 192, 5, padding=2), tnn.ReLU(inplace=True),
+        tnn.MaxPool2d(3, 2),
+        tnn.Conv2d(192, 384, 3, padding=1), tnn.ReLU(inplace=True),
+        tnn.Conv2d(384, 256, 3, padding=1), tnn.ReLU(inplace=True),
+        tnn.Conv2d(256, 256, 3, padding=1), tnn.ReLU(inplace=True),
+        tnn.MaxPool2d(3, 2),
+    )
+    classifier = tnn.Sequential(
+        tnn.Dropout(), tnn.Linear(256 * 6 * 6, 4096), tnn.ReLU(inplace=True),
+        tnn.Dropout(), tnn.Linear(4096, 4096), tnn.ReLU(inplace=True),
+        tnn.Linear(4096, num_classes),
+    )
+
+    class TorchAlexNet(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.features = features
+            self.avgpool = tnn.AdaptiveAvgPool2d((6, 6))
+            self.classifier = classifier
+
+        def forward(self, x):
+            x = self.features(x)
+            x = self.avgpool(x)
+            x = torch.flatten(x, 1)
+            return self.classifier(x)
+
+    return TorchAlexNet()
+
+
+@pytest.fixture(scope="module")
+def models():
+    torch.manual_seed(0)
+    ref = torch_alexnet().eval()
+    model = AlexNet(num_classes=10)
+    params, state = model.init(jax.random.key(0), jnp.zeros((1, 224, 224, 3)))
+    params = convert_alexnet_state_dict(ref.state_dict(), params)
+    return ref, model, params, state
+
+
+@pytest.mark.slow
+def test_imported_weights_reproduce_torch_logits(models):
+    ref, model, params, state = models
+    x = np.random.RandomState(0).randn(2, 224, 224, 3).astype(np.float32)
+    ours = model.apply(params, state, jnp.asarray(x), Context(train=False))[0]
+    with torch.no_grad():
+        theirs = ref(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.slow
+def test_load_from_pt_file(models, tmp_path):
+    ref, model, _, state = models
+    path = tmp_path / "alexnet.pt"
+    torch.save(ref.state_dict(), str(path))
+    fresh_params, _ = model.init(jax.random.key(1), jnp.zeros((1, 224, 224, 3)))
+    params = load_torch_alexnet(fresh_params, str(path))
+    x = np.random.RandomState(1).randn(1, 224, 224, 3).astype(np.float32)
+    ours = model.apply(params, state, jnp.asarray(x), Context(train=False))[0]
+    with torch.no_grad():
+        theirs = ref(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-3, atol=1e-3)
+
+
+def test_shape_mismatch_raises(models):
+    ref, model, params, _ = models
+    bad = dict(ref.state_dict())
+    bad["features.0.weight"] = torch.zeros(64, 3, 5, 5)
+    with pytest.raises(ValueError, match="features.0"):
+        convert_alexnet_state_dict(bad, params)

@@ -50,6 +50,7 @@ def run_training_loop(
     data_probe_every: Optional[int] = None,
     start_epoch: int = 0,
     scan_steps: int = 1,
+    per_replica_log: bool = False,
     log=print,
 ):
     """Run the full training loop; returns ``(state, history)`` where history
@@ -117,6 +118,29 @@ def run_training_loop(
         # Sync all processes before aggregating (reference :194).
         col.barrier("tpuddp_epoch", wait_for=(train_acc, eval_acc))
 
+        if (
+            per_replica_log
+            and train_acc is not None
+            # per-replica values are host-fetchable only when this process can
+            # address every shard (single-host); multi-host keeps the line out
+            and getattr(train_acc["loss_sum"], "is_fully_addressable", True)
+        ):
+            # pre-aggregation per-device loss lines (reference :186-191)
+            import numpy as np
+
+            tl, tn = np.asarray(train_acc["loss_sum"]), np.asarray(train_acc["n"])
+            el, en = np.asarray(eval_acc["loss_sum"]), np.asarray(eval_acc["n"])
+            for r in range(tl.size):
+                log(
+                    f"Train loss on replica {r}: {tl[r] / max(tn[r], 1):.4f} "
+                    f"based on {int(tn[r])} samples"
+                )
+            for r in range(el.size):
+                log(
+                    f"Test loss on replica {r}: {el[r] / max(en[r], 1):.4f} "
+                    f"based on {int(en[r])} samples"
+                )
+
         # Aggregate the five scalars (reference :198-204) in one fused pass.
         train_m = finalize_metrics(train_acc)
         eval_m = finalize_metrics(eval_acc)
@@ -133,6 +157,7 @@ def run_training_loop(
             "train_samples": train_m["n"],
             "test_samples": eval_m["n"],
             "epoch_time_s": epoch_time,
+            "samples_per_sec": (train_m["n"] + eval_m["n"]) / max(epoch_time, 1e-9),
         }
         history.append(record)
         metrics_writer.write(record)

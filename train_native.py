@@ -115,6 +115,7 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         data_probe_every=100,  # shard-disjointness probe (reference :112-115)
         start_epoch=start_epoch,
         scan_steps=int(training.get("scan_steps", 1)),
+        per_replica_log=True,  # reference's per-device loss lines (:186-191)
     )
 
 
