@@ -1,0 +1,86 @@
+"""Reference-stack oracle: the reference's native DDP training loop
+(multi-GPU-training-torch.py), run for real — 2 processes, torch.distributed
+over gloo (the reference's own CPU fallback, :36-37), DistributedSampler,
+DDP-wrapped MLP, Adam, sample-weighted loss sums all_reduced per epoch.
+
+Writes initial weights + the per-epoch loss curve for the parity comparison.
+
+Usage: python _torch_ddp_worker.py <data.npz> <out.json> <epochs> <batch> <lr>
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import torch
+import torch.distributed as dist
+import torch.multiprocessing as mp
+import torch.nn as nn
+from torch.nn.parallel import DistributedDataParallel as DDP
+from torch.utils.data import DataLoader, DistributedSampler, TensorDataset
+
+WORLD = 2
+
+
+def make_model(in_features: int):
+    torch.manual_seed(1234)
+    return nn.Sequential(
+        nn.Linear(in_features, 256), nn.ReLU(),
+        nn.Linear(256, 128), nn.ReLU(),
+        nn.Linear(128, 10),
+    )
+
+
+def worker(rank, data_path, out_path, epochs, batch, lr, weights_path):
+    os.environ["MASTER_ADDR"] = "127.0.0.1"
+    os.environ.setdefault("MASTER_PORT", "29512")
+    dist.init_process_group("gloo", rank=rank, world_size=WORLD)
+
+    data = np.load(data_path)
+    x = torch.from_numpy(data["x"])
+    y = torch.from_numpy(data["y"]).long()
+    ds = TensorDataset(x, y)
+
+    model = make_model(x.shape[1])
+    if rank == 0:
+        torch.save(model.state_dict(), weights_path)
+    ddp_model = DDP(model)
+    criterion = nn.CrossEntropyLoss()
+    optimizer = torch.optim.Adam(ddp_model.parameters(), lr=lr)
+
+    sampler = DistributedSampler(ds, num_replicas=WORLD, rank=rank, shuffle=False)
+    loader = DataLoader(ds, batch_size=batch, sampler=sampler)
+
+    curve = []
+    for epoch in range(epochs):
+        total = torch.zeros(1)
+        n = torch.zeros(1)
+        for inputs, labels in loader:
+            optimizer.zero_grad()
+            loss = criterion(ddp_model(inputs), labels)
+            loss.backward()
+            optimizer.step()
+            bs = inputs.shape[0]
+            total += loss.item() * bs
+            n += bs
+        dist.all_reduce(total)
+        dist.all_reduce(n)
+        curve.append(float(total.item() / n.item()))
+
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump({"train_loss": curve}, f)
+    dist.barrier()
+    dist.destroy_process_group()
+
+
+if __name__ == "__main__":
+    data_path, out_path, epochs, batch, lr = sys.argv[1:6]
+    weights_path = out_path + ".init.pt"
+    mp.spawn(
+        worker,
+        args=(data_path, out_path, int(epochs), int(batch), float(lr), weights_path),
+        nprocs=WORLD,
+        join=True,
+    )
