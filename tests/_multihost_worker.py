@@ -59,6 +59,26 @@ state, history = run_training_loop(
     num_epochs=2, checkpoint_epoch=1,
 )
 
+# --- managed (Accelerator) path over the same multi-process mesh ---
+from tpuddp.accelerate import Accelerator  # noqa: E402
+from tpuddp.data import DataLoader  # noqa: E402
+from tpuddp.models import ToyMLP  # noqa: E402
+
+acc = Accelerator(mesh=mesh, seed=7)
+m_model, m_opt, m_loader = acc.prepare(
+    ToyMLP(hidden=(16,)), optim.Adam(1e-2), DataLoader(ds, batch_size=4)
+)
+criterion = nn.CrossEntropyLoss()
+managed_losses = []
+m_loader.set_epoch(0)
+for i, (bx, by, bw) in enumerate(m_loader):
+    loss = criterion(m_model(bx), by, bw)
+    acc.backward(loss)
+    m_opt.step()
+    managed_losses.append(round(loss.item(), 6))
+    if i == 2:
+        break
+
 print(
     "WORKER_RESULT "
     + json.dumps(
@@ -67,6 +87,8 @@ print(
             "local_ranks": local,
             "train_loss": [round(h["train_loss"], 6) for h in history],
             "n": [h["train_samples"] for h in history],
+            "managed_losses": managed_losses,
+            "is_main": acc.is_main_process,
         }
     ),
     flush=True,

@@ -139,6 +139,10 @@ def test_native_cli_subprocess_with_reexec_launcher(tmp_path):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["TPUDDP_BACKEND"] = "cpu"
+    # keep the child TPU-free: a second tunnel client alongside the test
+    # process's registered one can crash the shared relay
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, "train_native.py", "--settings_file", str(sf)],
         capture_output=True,

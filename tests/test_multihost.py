@@ -65,6 +65,13 @@ def test_two_process_dp_world(tmp_path):
     )
     assert results[0]["n"] == results[1]["n"] == [128.0, 128.0]
 
+    # the managed (Accelerator) path agrees across processes too
+    assert len(results[0]["managed_losses"]) == 3
+    np.testing.assert_allclose(
+        results[0]["managed_losses"], results[1]["managed_losses"], rtol=1e-6
+    )
+    assert results[0]["is_main"] and not results[1]["is_main"]
+
     # process 0 only wrote the checkpoints; the loop's epoch log printed once
     assert os.path.exists(tmp_path / "ckpt_0.npz")
     assert os.path.exists(tmp_path / "ckpt_1.npz")

@@ -42,6 +42,10 @@ def test_loss_curve_parity_vs_torch_ddp(tmp_path, cpu_devices):
     out_path = tmp_path / "torch_curve.json"
     env = dict(os.environ)
     env["MASTER_PORT"] = "29517"
+    # torch-only workers: keep them off the TPU tunnel (sitecustomize would
+    # otherwise register a client in every spawned python)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tests", "_torch_ddp_worker.py"),
          str(data_path), str(out_path), str(EPOCHS), str(BATCH), str(LR)],
