@@ -151,8 +151,8 @@ def load_datasets(
         logging.getLogger("tpuddp").warning(
             "CIFAR-10 unavailable; using synthetic uint8 stand-in datasets"
         )
-        train = SyntheticClassification(n=synthetic_n[0], shape=(32, 32, 3), seed=0)
-        test = SyntheticClassification(n=synthetic_n[1], shape=(32, 32, 3), seed=1)
-        for ds in (train, test):
-            ds.images = np.clip((ds.images * 40 + 128), 0, 255).astype(np.uint8)
-        return train, test
+        full = SyntheticClassification(
+            n=synthetic_n[0] + synthetic_n[1], shape=(32, 32, 3), seed=0
+        )
+        full.images = np.clip((full.images * 40 + 128), 0, 255).astype(np.uint8)
+        return full.split(synthetic_n[1])

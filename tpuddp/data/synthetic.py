@@ -42,3 +42,19 @@ class SyntheticClassification:
     def get_batch(self, indices):
         idx = np.asarray(indices)
         return self.images[idx], self.labels[idx]
+
+    @classmethod
+    def from_arrays(cls, images: np.ndarray, labels: np.ndarray):
+        ds = cls.__new__(cls)
+        ds.images = images
+        ds.labels = labels
+        ds.num_classes = int(labels.max()) + 1 if len(labels) else 0
+        return ds
+
+    def split(self, n_test: int):
+        """(train, test) views sharing this dataset's class distribution —
+        a real generalization split, unlike two differently-seeded sets."""
+        return (
+            self.from_arrays(self.images[:-n_test], self.labels[:-n_test]),
+            self.from_arrays(self.images[-n_test:], self.labels[-n_test:]),
+        )
