@@ -174,40 +174,33 @@ def main():
         "toy_mlp f32 (per-step dispatch)", ToyMLP(num_classes=10), (32, 32, 3),
         128, steps=100,
     )
-    try:
-        bench_config(
-            "alexnet f32 (uint8->224 on-device)",
-            AlexNet(10),
-            (32, 32, 3),
-            128,
-            steps=30,
-            augment=make_train_augment(size=224),
-            x_dtype=np.uint8,
-        )
-        bench_config(
-            "alexnet bf16 (uint8->224 on-device)",
-            AlexNet(10),
-            (32, 32, 3),
-            128,
-            steps=30,
-            augment=make_train_augment(size=224, compute_dtype=jnp.bfloat16),
-            x_dtype=np.uint8,
-        )
-        # The TPU-friendly CIFAR recipe: a modern ResNet at the native 32x32
-        # resolution instead of paying the reference's 49x resize FLOPs.
+    def resnet18():
         from tpuddp.models import ResNet18
 
-        bench_config(
-            "resnet18 bf16 (native 32x32, sync-BN)",
+        # The TPU-friendly CIFAR recipe: a modern ResNet at the native 32x32
+        # resolution instead of paying the reference's 49x resize FLOPs.
+        return (
             ResNet18(10, sync_bn=True, small_input=True),
-            (32, 32, 3),
-            128,
-            steps=30,
-            augment=make_train_augment(size=None, compute_dtype=jnp.bfloat16),
-            x_dtype=np.uint8,
+            make_train_augment(size=None, compute_dtype=jnp.bfloat16),
         )
-    except Exception as e:  # diagnostics only — never break the headline line
-        log(f"cnn bench failed: {type(e).__name__}: {e}")
+
+    cnn_configs = [
+        ("alexnet f32 (uint8->224 on-device)",
+         lambda: (AlexNet(10), make_train_augment(size=224))),
+        ("alexnet bf16 (uint8->224 on-device)",
+         lambda: (AlexNet(10),
+                  make_train_augment(size=224, compute_dtype=jnp.bfloat16))),
+        ("resnet18 bf16 (native 32x32, sync-BN)", resnet18),
+    ]
+    for name, make in cnn_configs:
+        try:  # diagnostics only — independent, and never break the headline line
+            model, augment = make()
+            bench_config(
+                name, model, (32, 32, 3), 128, steps=30,
+                augment=augment, x_dtype=np.uint8,
+            )
+        except Exception as e:
+            log(f"{name} bench failed: {type(e).__name__}: {e}")
 
     baseline = bench_torch_cpu()
     vs = ours / baseline if baseline else 1.0
