@@ -1,0 +1,64 @@
+// tpuddp native data-path: multi-threaded row gather.
+//
+// The reference's data path leans on torch's native DataLoader machinery
+// (worker processes + pinned-memory copies, multi-GPU-training-torch.py:90-98).
+// tpuddp's equivalent hot host op is assembling a batch as a row-gather out of
+// the in-memory dataset (images[idx]); this implements it as parallel memcpy
+// with an optional tail-pad, callable from the loader via ctypes with a numpy
+// fallback when the library isn't built.
+//
+// Build: g++ -O3 -march=native -shared -fPIC gather.cpp -o libtpuddp_gather.so -lpthread
+// (driven by tpuddp/data/_native/__init__.py on first use).
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather n_idx rows of row_bytes each from src into dst, then pad dst with
+// copies of its first gathered row up to pad_rows total rows (the loader's
+// static-shape final-batch padding). n_threads <= 0 picks hardware threads.
+void tpuddp_gather_rows(const uint8_t* src, int64_t row_bytes,
+                        const int64_t* idx, int64_t n_idx, int64_t pad_rows,
+                        uint8_t* dst, int n_threads) {
+  if (n_idx <= 0) return;
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads <= 0) n_threads = hw > 0 ? hw : 4;
+  // small batches: threading overhead dominates, copy inline
+  const int64_t kMinRowsPerThread = 64;
+  int threads = static_cast<int>(
+      std::min<int64_t>(n_threads, std::max<int64_t>(1, n_idx / kMinRowsPerThread)));
+
+  auto copy_range = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      std::memcpy(dst + i * row_bytes, src + idx[i] * row_bytes,
+                  static_cast<size_t>(row_bytes));
+    }
+  };
+
+  if (threads <= 1) {
+    copy_range(0, n_idx);
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(threads);
+    int64_t chunk = (n_idx + threads - 1) / threads;
+    for (int t = 0; t < threads; ++t) {
+      int64_t lo = t * chunk;
+      int64_t hi = std::min<int64_t>(n_idx, lo + chunk);
+      if (lo >= hi) break;
+      pool.emplace_back(copy_range, lo, hi);
+    }
+    for (auto& th : pool) th.join();
+  }
+
+  for (int64_t i = n_idx; i < pad_rows; ++i) {
+    std::memcpy(dst + i * row_bytes, dst, static_cast<size_t>(row_bytes));
+  }
+}
+
+int tpuddp_native_abi_version() { return 1; }
+
+}  // extern "C"

@@ -32,8 +32,15 @@ import threading
 import jax
 import numpy as np
 
-from tpuddp.data import _native
 from tpuddp.parallel.sampler import DistributedSampler
+
+try:
+    from tpuddp.data import _native
+except ImportError:  # missing native package: numpy path only
+    class _native:  # type: ignore[no-redef]
+        @staticmethod
+        def gather_rows(src, indices, pad_rows=0):
+            return None
 
 
 def _fetch(dataset, indices: np.ndarray):
