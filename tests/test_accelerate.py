@@ -157,3 +157,34 @@ def test_save_model_writes_unwrapped_weights(acc, tmp_path):
 def test_gather_single_process(acc):
     x = jnp.arange(8.0)
     np.testing.assert_array_equal(acc.gather(x), np.arange(8.0))
+
+
+def test_deferred_metrics_matches_eager(cpu_devices):
+    """The opt-in deferred-metrics mode (one epoch-end transfer instead of a
+    per-batch loss.item() sync — quirk Q5 opt-out) must produce numerically
+    identical epoch metrics to the default eager mode."""
+    import train_accelerate as ta
+    from tpuddp.data.transforms import make_eval_transform, make_train_augment
+
+    mesh = make_mesh(cpu_devices)
+    results = []
+    for deferred in (False, True):
+        accel = Accelerator(mesh=mesh, seed=7)
+        ds = SyntheticClassification(n=64, shape=(8, 8, 3), seed=3)
+        train_loader = DataLoader(ds, batch_size=8, shuffle=True)
+        test_loader = DataLoader(ds, batch_size=8)
+        model, opt, prepared_loader = accel.prepare(
+            ToyMLP(hidden=(16,)), optim.Adam(1e-2), train_loader
+        )
+        criterion = nn.CrossEntropyLoss()
+        augment = jax.jit(make_train_augment(size=None))
+        eval_tf = jax.jit(make_eval_transform(size=None))
+        prepared_loader.set_epoch(0)
+        tr = ta.train(
+            model, prepared_loader, criterion, opt, accel, augment, deferred=deferred
+        )
+        te, pct = ta.evaluate(
+            model, test_loader, criterion, accel.device, eval_tf, deferred=deferred
+        )
+        results.append((tr, te, pct))
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-6)

@@ -90,13 +90,19 @@ class LazyLoss:
         )
         self._value = loss
 
-    def item(self) -> float:
+    def device_value(self):
+        """The loss as a device scalar with NO host sync — the deferred-metrics
+        accumulator primitive (quirk Q5: ``loss.item()`` per batch is the
+        reference's per-batch device sync; this is the opt-out)."""
         if self._value is None:
             logits = jnp.asarray(self._fwd.value)
             self._value = self._criterion(
                 logits, jnp.asarray(self._labels), self._weights
             )
-        return float(self._value)
+        return self._value
+
+    def item(self) -> float:
+        return float(self.device_value())
 
     def __float__(self):
         return self.item()
@@ -129,9 +135,18 @@ class PreparedModel:
     def _ensure_init(self, x):
         if self.params is not None:
             return
-        key = self.accelerator._next_key()
-        sample = jax.ShapeDtypeStruct((1,) + tuple(np.shape(x))[1:], jnp.asarray(x[:1]).dtype)
-        params, mstate = self.module.init(key, sample)
+        # Pretrained fine-tune hook: a module carrying pre-loaded variables
+        # (tpuddp.models.torch_import.load_pretrained_alexnet) starts from
+        # them instead of a fresh init.
+        preloaded = getattr(self.module, "_tpuddp_initial_variables", None)
+        if preloaded is not None:
+            params, mstate = preloaded
+        else:
+            key = self.accelerator._next_key()
+            sample = jax.ShapeDtypeStruct(
+                (1,) + tuple(np.shape(x))[1:], jnp.asarray(x[:1]).dtype
+            )
+            params, mstate = self.module.init(key, sample)
         params, mstate = col.broadcast_one_to_all((params, mstate))
         self.params, self.model_state = replicate(
             self.accelerator.mesh, (params, mstate)
@@ -253,6 +268,11 @@ class Accelerator:
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    def next_rng_key(self):
+        """A fresh PRNG key from the accelerator's per-process stream (for
+        host-driven augmentation in the managed path)."""
+        return self._next_key()
 
     # -- the core verbs --
     def prepare(self, *objects):

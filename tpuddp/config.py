@@ -37,6 +37,8 @@ TRAINING_DEFAULTS = {
     "scan_steps": 1,  # >1 fuses K train steps per dispatch (lax.scan)
     "remat": False,  # jax.checkpoint: recompute activations in backward
     "prefetch": True,  # background-thread host batch prefetch
+    "deferred_metrics": False,  # managed path: epoch-end (not per-batch) metric sync
+    "pretrained_path": None,  # torch state_dict to fine-tune from (AlexNet)
 }
 
 

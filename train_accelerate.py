@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import logging
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -24,7 +25,7 @@ from tpuddp import nn, optim
 from tpuddp.accelerate import Accelerator
 from tpuddp.data import DataLoader
 from tpuddp.data.cifar10 import load_datasets
-from tpuddp.data.transforms import make_eval_transform
+from tpuddp.data.transforms import make_eval_transform, make_train_augment
 
 logging.basicConfig(level=logging.INFO, format="%(message)s")
 
@@ -42,46 +43,79 @@ def setup_dataloaders(training):
     return train_loader, test_loader
 
 
-def train(model, train_loader, criterion, optimizer, accelerator, transform):
+def train(
+    model, train_loader, criterion, optimizer, accelerator, augment, deferred=False
+):
     model.train()
     running_loss = 0.0
+    batch_losses = []
     for inputs, labels, weights in train_loader:
         # no .to(device): placement is the backend's job (reference :44 note)
         optimizer.zero_grad()
 
-        # Forward pass (deferred; fused with backward by the Accelerator)
-        outputs = model(transform_host(transform, inputs))
-        loss = criterion(outputs, labels, weights)
+        # Flip-augmented inputs (reference transform_train includes
+        # RandomHorizontalFlip, data_and_toy_model.py:14-19), keyed off the
+        # accelerator's per-process PRNG stream.
+        x = augment(accelerator.next_rng_key(), jnp.asarray(inputs))
 
-        # Backward pass and optimize
-        accelerator.backward(loss)  # instead of loss.backward()
+        # model(...) and criterion(...) record lazily; accelerator.backward
+        # runs them as ONE jitted value_and_grad over the sharded global batch,
+        # and step() applies the stashed averaged grads.
+        outputs = model(x)
+        loss = criterion(outputs, labels, weights)
+        accelerator.backward(loss)
         optimizer.step()
 
-        running_loss += loss.item()
+        if deferred:
+            batch_losses.append(loss.device_value())
+        else:
+            running_loss += loss.item()  # per-batch host sync (Q5 parity mode)
+    if deferred:
+        running_loss = float(np.sum(jax.device_get(batch_losses)))
     return running_loss / len(train_loader)
 
 
 def transform_host(transform, inputs):
-    """Apply the eval/train-agnostic resize+normalize before the managed
-    forward (the managed path keeps the torch-like 'model(inputs)' shape, so
-    the transform runs as a separate jitted op rather than fused)."""
+    """Resize+normalize before the managed forward (the managed path keeps the
+    torch-like 'model(inputs)' shape, so the transform runs as a separate
+    jitted op rather than fused into the step)."""
     return transform(jnp.asarray(inputs))
 
 
-def evaluate(model, test_loader, criterion, device, transform):
+def evaluate(model, test_loader, criterion, device, transform, deferred=False):
     model.eval()
     correct = 0
     total = 0
     test_loss = 0.0
+    device_stats = []
     for inputs, labels, weights in test_loader:
         inputs = transform_host(transform, inputs)
         outputs = model(inputs)
         loss = criterion(outputs, labels, weights)
-        test_loss += loss.item()
-        predicted = np.asarray(outputs.argmax(axis=-1))
-        mask = weights > 0
-        total += int(mask.sum())
-        correct += int(((predicted == labels) & mask).sum())
+        if deferred:
+            # accumulate (loss, n_correct, n) as device scalars; one transfer
+            # at epoch end instead of three syncs per batch
+            predicted = outputs.argmax(axis=-1)
+            labels_d = jnp.asarray(labels)
+            mask_d = jnp.asarray(weights) > 0
+            device_stats.append(
+                (
+                    loss.device_value(),
+                    ((predicted == labels_d) & mask_d).sum(),
+                    mask_d.sum(),
+                )
+            )
+        else:
+            test_loss += loss.item()
+            predicted = np.asarray(outputs.argmax(axis=-1))
+            mask = weights > 0
+            total += int(mask.sum())
+            correct += int(((predicted == labels) & mask).sum())
+    if deferred:
+        stats = jax.device_get(device_stats)
+        test_loss = float(np.sum([s[0] for s in stats]))
+        correct = int(np.sum([s[1] for s in stats]))
+        total = int(np.sum([s[2] for s in stats]))
     accuracy = 100 * correct / total
     return test_loss / len(test_loader), accuracy
 
@@ -94,20 +128,33 @@ def run_training_loop(
     optimizer,
     save_dir,
     accelerator,
-    transform,
+    augment,
+    eval_transform,
     num_epochs=20,
     checkpoint_epoch=5,
+    deferred_metrics=False,
 ):
     for epoch in range(num_epochs):
         train_loader.set_epoch(epoch)
         train_loss = train(
-            model, train_loader, criterion, optimizer, accelerator, transform
+            model,
+            train_loader,
+            criterion,
+            optimizer,
+            accelerator,
+            augment,
+            deferred=deferred_metrics,
         )
         test_loss, test_accuracy = evaluate(
-            model, test_loader, criterion, accelerator.device, transform
+            model,
+            test_loader,
+            criterion,
+            accelerator.device,
+            eval_transform,
+            deferred=deferred_metrics,
         )
 
-        # only print loss vals for one process (reference :96-102)
+        # epoch summary, gated to one process (reference :96-102)
         if accelerator.is_local_main_process:
             print(
                 f"Epoch {epoch + 1}/{num_epochs}, "
@@ -117,9 +164,9 @@ def run_training_loop(
             )
 
         if epoch % checkpoint_epoch == 0:
-            # Wait for all parallel runs to finish (reference :104-108)
+            # barrier, then a single-writer save of the unwrapped weights
+            # (reference :104-108)
             accelerator.wait_for_everyone()
-            # Unwrap & save the distributed training interface
             accelerator.save_model(model, save_dir)
 
     print("Finished Training.")
@@ -127,23 +174,26 @@ def run_training_loop(
 
 def basic_accelerate_training(out_dir: str, training=None):
     training = training or cfg_lib.TRAINING_DEFAULTS
-    # Initialize accelerator state (reference :115)
+    # Topology discovery happens inside the Accelerator (reference :115).
     accelerator = Accelerator(seed=training.get("seed"))
 
-    # Load data and model (reference :118-122); no .to(device) needed.
+    # Data + model (reference :118-122); placement is implicit on this path.
     train_loader, test_loader = setup_dataloaders(training)
     model = load_model_for(training)
 
     criterion = nn.CrossEntropyLoss()
     optimizer = optim.Adam(lr=training["learning_rate"])
 
-    # Prepare DDP with the accelerator (reference :129-131): test_loader is
-    # deliberately NOT prepared (quirk Q3 parity).
+    # prepare() wraps model/optimizer/train loader for the mesh backend
+    # (reference :129-131); test_loader deliberately stays unprepared
+    # (quirk Q3 parity).
     model, optimizer, training_dataloader = accelerator.prepare(
         model, optimizer, train_loader
     )
 
-    transform = make_eval_transform(size=training.get("image_size"))
+    # jitted so each runs as one fused device op, not eager op-by-op
+    augment = jax.jit(make_train_augment(size=training.get("image_size")))
+    eval_transform = jax.jit(make_eval_transform(size=training.get("image_size")))
     run_training_loop(
         model,
         training_dataloader,
@@ -152,16 +202,25 @@ def basic_accelerate_training(out_dir: str, training=None):
         optimizer,
         out_dir,
         accelerator,
-        transform,
+        augment,
+        eval_transform,
         num_epochs=training["num_epochs"],
         checkpoint_epoch=training["checkpoint_epoch"],
+        deferred_metrics=bool(training.get("deferred_metrics")),
     )
 
 
 def load_model_for(training):
     from tpuddp.models import load_model
 
-    model = load_model(training["model"])
+    if training.get("pretrained_path"):
+        from tpuddp.models.torch_import import pretrained_from_config
+
+        model, params, mstate = pretrained_from_config(training)
+        # consumed by PreparedModel._ensure_init instead of a fresh init
+        model._tpuddp_initial_variables = (params, mstate)
+    else:
+        model = load_model(training["model"])
     if training.get("sync_bn"):
         nn.convert_sync_batchnorm(model)
     return model
@@ -169,14 +228,15 @@ def load_model_for(training):
 
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(
-        description="Run script based on local_settings.yaml file.",
+        description="tpuddp managed-API training (Accelerator over the XLA "
+        "mesh backend).",
     )
     parser.add_argument(
         "--settings_file",
         type=str,
         required=True,
-        help="Path to local_settings.yaml file specifying cluster settings and "
-        "other parameters.",
+        help="YAML settings (see local_settings.yaml for the schema: out_dir, "
+        "local.{device,tpu}, optional_args, training overrides).",
     )
     args = parser.parse_args()
 
