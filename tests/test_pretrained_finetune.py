@@ -1,0 +1,127 @@
+"""Pretrained-AlexNet fine-tune workflow, end to end (the reference's central
+``alexnet(weights=DEFAULT)`` + head-swap move, data_and_toy_model.py:41-45):
+a torch AlexNet checkpoint saved to disk is consumed via
+``training.pretrained_path`` by the native entrypoint, head swapped 1000->10,
+and the fine-tuned epoch-1 loss beats training from scratch."""
+
+import re
+from functools import partial
+
+import jax
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from test_torch_import import torch_alexnet
+
+from tpuddp.data.cifar10 import CIFAR10_MEAN, CIFAR10_STD
+from tpuddp.data.synthetic import SyntheticClassification
+
+
+def _small_uint8_datasets():
+    """A small uint8 stand-in with the synthetic fallback's format."""
+    full = SyntheticClassification(n=320, shape=(32, 32, 3), seed=0)
+    full.images = np.clip(full.images * 40 + 128, 0, 255).astype(np.uint8)
+    return full.split(64)
+
+
+def _pretrain_torch(train_ds, steps=60, image_size=64):
+    """Fit a 1000-class-head torch AlexNet on the same data distribution the
+    fine-tune will see (stand-in for ImageNet pretraining)."""
+    torch.manual_seed(0)
+    model = torch_alexnet(num_classes=1000)
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    mean = torch.tensor(CIFAR10_MEAN).view(1, 3, 1, 1)
+    std = torch.tensor(CIFAR10_STD).view(1, 3, 1, 1)
+    rng = np.random.RandomState(0)
+    for _ in range(steps):
+        idx = rng.randint(0, len(train_ds), size=64)
+        x = torch.from_numpy(
+            train_ds.images[idx].astype(np.float32).transpose(0, 3, 1, 2) / 255.0
+        )
+        x = F.interpolate((x - mean) / std, size=image_size, mode="bilinear")
+        y = torch.from_numpy(train_ds.labels[idx].astype(np.int64))
+        opt.zero_grad()
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+    return model, float(loss.detach())
+
+
+def _run_native(tmp_path, capsys, monkeypatch, datasets, training):
+    import train_native
+    from tpuddp.parallel import backend
+    from tpuddp.parallel.spawn import run_ddp_training
+
+    monkeypatch.setattr(
+        train_native, "load_datasets", lambda *a, **k: datasets
+    )
+    backend.cleanup()
+    run_ddp_training(
+        partial(train_native.basic_ddp_training_loop, training=training),
+        world_size=8,
+        save_dir=str(tmp_path),
+        optional_args={"set_epoch": True},
+        backend="cpu",
+    )
+    backend.cleanup()
+    out = capsys.readouterr().out
+    m = re.search(r"Epoch 1/1, Train Loss: ([0-9.]+)", out)
+    assert m, f"no epoch summary in output:\n{out[-2000:]}"
+    return float(m.group(1)), out
+
+
+@pytest.mark.slow
+def test_pretrained_finetune_beats_scratch(tmp_path, capsys, monkeypatch):
+    datasets = _small_uint8_datasets()
+    donor, pre_loss = _pretrain_torch(datasets[0])
+    assert pre_loss < 2.0, f"torch pretraining did not learn (loss {pre_loss})"
+    ckpt = tmp_path / "alexnet_imagenet.pt"
+    torch.save(donor.state_dict(), str(ckpt))
+
+    training = {
+        "model": "alexnet",
+        "dataset": "cifar10",
+        "data_root": "/nonexistent",
+        "train_batch_size": 8,
+        "test_batch_size": 8,
+        "learning_rate": 0.001,
+        "num_epochs": 1,
+        "checkpoint_epoch": 5,
+        "image_size": 64,
+        "seed": 0,
+        "mode": "shard_map",
+        "prefetch": False,
+    }
+    scratch_loss, _ = _run_native(
+        tmp_path / "scratch", capsys, monkeypatch, datasets, training
+    )
+    finetune_loss, out = _run_native(
+        tmp_path / "finetune",
+        capsys,
+        monkeypatch,
+        datasets,
+        dict(training, pretrained_path=str(ckpt)),
+    )
+    assert "Loaded pretrained AlexNet weights" in out
+    assert finetune_loss < scratch_loss, (finetune_loss, scratch_loss)
+
+
+def test_load_pretrained_swaps_head_and_keeps_features(tmp_path):
+    """1000-class torch checkpoint -> 10-class tpuddp model: head is fresh
+    (4096x10), features are the donor's (logit check on the donor head is in
+    test_torch_import; here the converted conv weights must match)."""
+    from tpuddp.models.torch_import import load_pretrained_alexnet
+
+    torch.manual_seed(1)
+    donor = torch_alexnet(num_classes=1000)
+    path = tmp_path / "donor.pt"
+    torch.save(donor.state_dict(), str(path))
+
+    model, params, _ = load_pretrained_alexnet(
+        str(path), jax.random.key(0), num_classes=10, image_size=64
+    )
+    assert params[-1]["weight"].shape == (4096, 10)
+    conv0 = donor.state_dict()["features.0.weight"].numpy().transpose(2, 3, 1, 0)
+    np.testing.assert_allclose(np.asarray(params[0]["weight"]), conv0, rtol=1e-6)

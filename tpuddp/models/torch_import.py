@@ -95,3 +95,55 @@ def load_torch_alexnet(params, path: str):
     if hasattr(state_dict, "state_dict"):
         state_dict = state_dict.state_dict()
     return convert_alexnet_state_dict(state_dict, params)
+
+
+def load_pretrained_alexnet(
+    path: str, key, num_classes: int = 10, image_size: int = 224
+):
+    """The reference's fine-tune-from-pretrained workflow
+    (data_and_toy_model.py:41-45), from a torch checkpoint on disk: build an
+    AlexNet sized to the checkpoint's own head (e.g. 1000-class ImageNet),
+    import the weights, then swap in a fresh ``num_classes`` head when the
+    widths differ. Returns ``(model, params, model_state)`` ready for
+    ``DistributedDataParallel.init_state`` / ``Accelerator.prepare``.
+    """
+    import jax
+    import torch
+
+    from tpuddp.models.alexnet import AlexNet, replace_head
+
+    state_dict = torch.load(path, map_location="cpu", weights_only=True)
+    if hasattr(state_dict, "state_dict"):
+        state_dict = state_dict.state_dict()
+    head_out = int(_to_np(state_dict["classifier.6.weight"]).shape[0])
+
+    model = AlexNet(num_classes=head_out)
+    init_key, head_key = jax.random.split(jax.random.fold_in(key, 0x9e7))
+    params, model_state = model.init(
+        init_key, jnp.zeros((1, image_size, image_size, 3))
+    )
+    params = convert_alexnet_state_dict(state_dict, params)
+    if head_out != num_classes:
+        params = replace_head(model, params, head_key, num_classes)
+    return model, params, model_state
+
+
+def pretrained_from_config(training: Mapping[str, object], key=None):
+    """Entrypoint-shared ``training.pretrained_path`` handling: validate the
+    model name, derive the head-init key from ``training.seed`` when the
+    caller has no rank-seeded stream, and load. Returns
+    ``(model, params, model_state)``."""
+    import jax
+
+    if training["model"] != "alexnet":
+        raise ValueError(
+            "training.pretrained_path supports model 'alexnet' "
+            f"(got {training['model']!r})"
+        )
+    if key is None:
+        key = jax.random.key(int(training.get("seed") or 0))
+    return load_pretrained_alexnet(
+        str(training["pretrained_path"]),
+        key,
+        image_size=int(training.get("image_size") or 224),
+    )

@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 
 from tpuddp.nn.core import Context
 from tpuddp.nn.loss import CrossEntropyLoss
@@ -64,11 +65,33 @@ class DistributedDataParallel:
     def world_size(self) -> int:
         return self.mesh.devices.size
 
-    def init_state(self, key, sample_input) -> TrainState:
+    def init_state(self, key, sample_input, params=None, model_state=None) -> TrainState:
         """Create replicated train state. Parameters are broadcast from
         process 0 (multi-host) and placed replicated on every mesh device —
-        the DDP construction contract."""
-        state = create_train_state(self.model, self.optimizer, key, sample_input)
+        the DDP construction contract.
+
+        ``params``/``model_state`` override the fresh initialization with
+        caller-supplied values (the pretrained fine-tune path,
+        data_and_toy_model.py:41-45); optimizer state is re-derived from the
+        supplied params."""
+        if (params is None) != (model_state is None):
+            raise ValueError(
+                "init_state needs params and model_state together: pretrained "
+                "params with freshly-initialized buffers (e.g. BatchNorm "
+                "running stats) would silently mis-normalize"
+            )
+        if params is not None:
+            # caller already owns the variables; skip the (large) fresh init
+            _, run_key = jax.random.split(key)
+            state = TrainState(
+                params=params,
+                model_state=model_state,
+                opt_state=self.optimizer.init(params),
+                step=jnp.zeros((), jnp.int32),
+                rng=run_key,
+            )
+        else:
+            state = create_train_state(self.model, self.optimizer, key, sample_input)
         state = col.broadcast_one_to_all(state)
         return replicate(self.mesh, state)
 

@@ -65,18 +65,27 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         train_loader = PrefetchLoader(train_loader)
         test_loader = PrefetchLoader(test_loader)
 
-    model = load_model(training["model"])
+    # Device-side transform pipeline (replaces data_and_toy_model.py:13-29).
+    size = training.get("image_size")
+    augment = make_train_augment(size=size)
+    eval_transform = make_eval_transform(size=size)
+
+    # Model, optionally fine-tuning from a torch checkpoint on disk — the
+    # reference's central pretrained-AlexNet workflow (data_and_toy_model.py:41-45).
+    init_params = init_mstate = None
+    if training.get("pretrained_path"):
+        from tpuddp.models.torch_import import pretrained_from_config
+
+        model, init_params, init_mstate = pretrained_from_config(training, key)
+        print(f"Loaded pretrained AlexNet weights from {training['pretrained_path']}.")
+    else:
+        model = load_model(training["model"])
     if training.get("sync_bn"):
         nn.convert_sync_batchnorm(model)
 
     # Loss + optimizer (reference :248-249).
     criterion = nn.CrossEntropyLoss()
     optimizer = optim.Adam(lr=training["learning_rate"])
-
-    # Device-side transform pipeline (replaces data_and_toy_model.py:13-29).
-    size = training.get("image_size")
-    augment = make_train_augment(size=size)
-    eval_transform = make_eval_transform(size=size)
 
     # The DDP wrap (reference :245): builds the shard_map'd pmean train step.
     ddp = DistributedDataParallel(
@@ -90,7 +99,9 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         remat=bool(training.get("remat", False)),
     )
     in_hw = size if size else train_ds.images.shape[1]
-    state = ddp.init_state(key, jnp.zeros((1, in_hw, in_hw, 3)))
+    state = ddp.init_state(
+        key, jnp.zeros((1, in_hw, in_hw, 3)), params=init_params, model_state=init_mstate
+    )
 
     # Resume path (the reference only documents loading, README.md:51-52):
     # training.resume: true restores the newest ckpt_{epoch}.npz in out_dir.
@@ -121,14 +132,15 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
 
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(
-        description="Run script based on local_settings.yaml file.",
+        description="tpuddp explicit-API DP training (ShardedDataLoader + "
+        "DistributedDataParallel over the XLA mesh backend).",
     )
     parser.add_argument(
         "--settings_file",
         type=str,
         required=True,
-        help="Path to local_settings.yaml file specifying cluster settings and "
-        "other parameters.",
+        help="YAML settings (see local_settings.yaml for the schema: out_dir, "
+        "local.{device,tpu}, optional_args, training overrides).",
     )
     args = parser.parse_args()
 
