@@ -34,7 +34,7 @@ TRAINING_DEFAULTS = {
     "seed": None,  # None -> fresh per run, like torch initial_seed
     "mode": "shard_map",
     "sync_bn": False,
-    "scan_steps": 1,  # >1 fuses K train steps per dispatch (lax.scan)
+    "scan_steps": "auto",  # K train steps fused per dispatch (lax.scan); "auto" = up to 8
     "remat": False,  # jax.checkpoint: recompute activations in backward
     "prefetch": True,  # background-thread host batch prefetch
     "deferred_metrics": False,  # managed path: epoch-end (not per-batch) metric sync

@@ -86,6 +86,11 @@ def shard_batch(mesh: Mesh, batch):
     """
     def _put(x):
         sharding = NamedSharding(mesh, P(DATA_AXIS, *([None] * (x.ndim - 1))))
+        if (
+            isinstance(x, jax.Array)
+            and x.sharding.is_equivalent_to(sharding, x.ndim)
+        ):
+            return x  # already laid out correctly: no copy, no dispatch
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(sharding, np.asarray(x))
         return jax.device_put(x, sharding)

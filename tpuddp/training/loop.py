@@ -37,6 +37,22 @@ from tpuddp.utils.observability import (
 logger = logging.getLogger("tpuddp")
 
 
+def resolve_scan_steps(scan_steps, n_batches: int) -> int:
+    """Resolve the per-dispatch fusion factor K.
+
+    ``"auto"`` (the default) fuses up to 8 batches per dispatch when the
+    epoch has at least that many — the measured per-dispatch runtime latency
+    dominates per-step time otherwise (BASELINE.md: ~7x on the toy model
+    through a tunneled TPU). Any integer pins K explicitly; 1 disables
+    fusion (one dispatch per batch, the reference's cadence)."""
+    if scan_steps in (None, "auto"):
+        return max(1, min(8, n_batches))
+    k = int(scan_steps)
+    if k < 1:
+        raise ValueError(f"scan_steps must be >= 1 or 'auto', got {scan_steps!r}")
+    return k
+
+
 def run_training_loop(
     ddp,
     state,
@@ -49,7 +65,7 @@ def run_training_loop(
     print_rand: bool = False,
     data_probe_every: Optional[int] = None,
     start_epoch: int = 0,
-    scan_steps: int = 1,
+    scan_steps="auto",
     per_replica_log: bool = False,
     log=print,
 ):
@@ -61,6 +77,7 @@ def run_training_loop(
     batches (ShardedDataLoader for DP; see tpuddp.data.loader).
     """
     is_main = jax.process_index() == 0
+    scan_steps = resolve_scan_steps(scan_steps, len(train_loader))
     history = []
     metrics_writer = MetricsWriter(save_dir)
     profiling = maybe_start_profiler(save_dir)  # $TPUDDP_PROFILE hook
@@ -89,6 +106,9 @@ def run_training_loop(
         # `scan_steps` batches fused into a single lax.scan dispatch) ----
         train_acc = None
         chunk = []
+        staged = None  # one-chunk upload lookahead: device_put is async, so
+        # staging chunk N+1 before dispatching N overlaps host->HBM transfer
+        # with the previous dispatch's compute
         for batch_idx, host_batch in enumerate(train_loader):
             if data_probe_every and batch_idx % data_probe_every == 0:
                 probe = getattr(train_loader, "probe_fingerprint", None)
@@ -100,10 +120,15 @@ def run_training_loop(
                 continue
             chunk.append(host_batch)
             if len(chunk) == scan_steps:
-                stacked = ddp.shard_stacked(stack_batches(chunk))
-                state, metrics = ddp.train_step_many(state, stacked)
-                train_acc = accumulate_metrics(train_acc, metrics)
+                next_staged = ddp.shard_stacked(stack_batches(chunk))
                 chunk = []
+                if staged is not None:
+                    state, metrics = ddp.train_step_many(state, staged)
+                    train_acc = accumulate_metrics(train_acc, metrics)
+                staged = next_staged
+        if staged is not None:
+            state, metrics = ddp.train_step_many(state, staged)
+            train_acc = accumulate_metrics(train_acc, metrics)
         for host_batch in chunk:  # remainder: single steps, same semantics
             state, metrics = ddp.train_step(state, ddp.shard(host_batch))
             train_acc = accumulate_metrics(train_acc, metrics)
@@ -115,21 +140,32 @@ def run_training_loop(
             metrics = ddp.eval_step(state, batch)
             eval_acc = accumulate_metrics(eval_acc, metrics)
 
+        if train_acc is None:
+            raise RuntimeError(
+                "train loader yielded no batches this epoch; check the dataset "
+                "and batch size"
+            )
+
         # Sync all processes before aggregating (reference :194).
         col.barrier("tpuddp_epoch", wait_for=(train_acc, eval_acc))
 
         if (
             per_replica_log
-            and train_acc is not None
+            and eval_acc is not None
             # per-replica values are host-fetchable only when this process can
             # address every shard (single-host); multi-host keeps the line out
             and getattr(train_acc["loss_sum"], "is_fully_addressable", True)
         ):
-            # pre-aggregation per-device loss lines (reference :186-191)
-            import numpy as np
-
-            tl, tn = np.asarray(train_acc["loss_sum"]), np.asarray(train_acc["n"])
-            el, en = np.asarray(eval_acc["loss_sum"]), np.asarray(eval_acc["n"])
+            # pre-aggregation per-device loss lines (reference :186-191);
+            # ONE host fetch for all four arrays, not four round trips
+            tl, tn, el, en = jax.device_get(
+                (
+                    train_acc["loss_sum"],
+                    train_acc["n"],
+                    eval_acc["loss_sum"],
+                    eval_acc["n"],
+                )
+            )
             for r in range(tl.size):
                 log(
                     f"Train loss on replica {r}: {tl[r] / max(tn[r], 1):.4f} "
@@ -141,12 +177,21 @@ def run_training_loop(
                     f"based on {int(en[r])} samples"
                 )
 
-        # Aggregate the five scalars (reference :198-204) in one fused pass.
-        train_m = finalize_metrics(train_acc)
-        eval_m = finalize_metrics(eval_acc)
+        # Aggregate the five scalars (reference :198-204) in ONE fused
+        # cross-device pass + one host fetch.
+        combined = {"train": train_acc}
+        if eval_acc is not None:
+            combined["eval"] = eval_acc
+        sums = finalize_metrics(combined)
+        train_m, eval_m = sums["train"], sums.get("eval")
         train_loss = train_m["loss_sum"] / max(train_m["n"], 1.0)
-        test_loss = eval_m["loss_sum"] / max(eval_m["n"], 1.0)
-        test_accuracy = 100.0 * eval_m["correct"] / max(eval_m["n"], 1.0)
+        if eval_m is not None:
+            test_loss = eval_m["loss_sum"] / max(eval_m["n"], 1.0)
+            test_accuracy = 100.0 * eval_m["correct"] / max(eval_m["n"], 1.0)
+        else:  # empty test loader: report train-only metrics
+            eval_m = {"n": 0.0}
+            test_loss = float("nan")
+            test_accuracy = float("nan")
 
         epoch_time = time.perf_counter() - t0
         record = {

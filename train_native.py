@@ -52,7 +52,12 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
 
     # Data + model (reference :237-238); synthetic fallback keeps the tutorial
     # runnable with no dataset staged (zero-egress environments).
-    train_ds, test_ds = load_datasets(training["data_root"], synthetic_fallback=True)
+    load_kwargs = {}
+    if training.get("synthetic_n"):  # synthetic stand-in sizing (benchmarks/CI)
+        load_kwargs["synthetic_n"] = tuple(training["synthetic_n"])
+    train_ds, test_ds = load_datasets(
+        training["data_root"], synthetic_fallback=True, **load_kwargs
+    )
     train_loader = ShardedDataLoader(
         train_ds, training["train_batch_size"], mesh, shuffle=True
     )
@@ -125,7 +130,7 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         print_rand=optional_args.get("print_rand", False),
         data_probe_every=100,  # shard-disjointness probe (reference :112-115)
         start_epoch=start_epoch,
-        scan_steps=int(training.get("scan_steps", 1)),
+        scan_steps=training.get("scan_steps", "auto"),
         per_replica_log=True,  # reference's per-device loss lines (:186-191)
     )
 
