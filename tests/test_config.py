@@ -79,3 +79,46 @@ def test_repo_example_settings_parse():
         "set_epoch": True,
         "print_rand": False,
     }
+
+
+def test_rendezvous_absent_is_empty():
+    assert cfg.rendezvous_from({}) == {}
+    assert cfg.rendezvous_from({"local": {}}) == {}
+
+
+def test_rendezvous_block_parses():
+    s = {"local": {"rendezvous": {
+        "coordinator_address": "10.0.0.1:8476",
+        "num_processes": 4,
+        "process_id": 2,
+    }}}
+    assert cfg.rendezvous_from(s) == {
+        "coordinator_address": "10.0.0.1:8476",
+        "num_processes": 4,
+        "process_id": 2,
+    }
+
+
+def test_rendezvous_env_overrides(monkeypatch):
+    """One shared YAML across hosts: the launcher sets the per-host id in the
+    environment (torchrun's RANK analog)."""
+    s = {"local": {"rendezvous": {
+        "coordinator_address": "10.0.0.1:8476", "num_processes": 2,
+    }}}
+    with pytest.raises(ValueError):  # num_processes>1 needs a process id
+        cfg.rendezvous_from(s)
+    monkeypatch.setenv("TPUDDP_PROCESS_ID", "1")
+    assert cfg.rendezvous_from(s)["process_id"] == 1
+    monkeypatch.setenv("TPUDDP_COORDINATOR", "10.0.0.9:9999")
+    monkeypatch.setenv("TPUDDP_NUM_PROCESSES", "8")
+    out = cfg.rendezvous_from({})
+    assert out == {
+        "coordinator_address": "10.0.0.9:9999",
+        "num_processes": 8,
+        "process_id": 1,
+    }
+
+
+def test_rendezvous_unknown_key_rejected():
+    with pytest.raises(ValueError):
+        cfg.rendezvous_from({"local": {"rendezvous": {"master_addr": "x"}}})

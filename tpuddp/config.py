@@ -86,6 +86,48 @@ def device_from(settings: Dict[str, Any]) -> Optional[str]:
     raise ValueError(f"unsupported local.device {dev!r} (expected tpu or cpu)")
 
 
+def rendezvous_from(settings: Dict[str, Any]) -> Dict[str, Any]:
+    """``local.rendezvous`` block -> kwargs for ``run_ddp_training``.
+
+    The TPU-native analog of the reference's ``MASTER_ADDR``/``MASTER_PORT``
+    rendezvous (multi-GPU-training-torch.py:30-31): ``coordinator_address``
+    ("host:port"), ``num_processes``, ``process_id``. Environment overrides
+    ``TPUDDP_COORDINATOR`` / ``TPUDDP_NUM_PROCESSES`` / ``TPUDDP_PROCESS_ID``
+    let one shared settings file serve every host of a pod — the launcher sets
+    the per-host process id in the environment, exactly as torchrun exports
+    RANK alongside a shared MASTER_ADDR.
+    """
+    rdv = dict(settings.get("local", {}).get("rendezvous") or {})
+    env = os.environ
+    if env.get("TPUDDP_COORDINATOR"):
+        rdv["coordinator_address"] = env["TPUDDP_COORDINATOR"]
+    if env.get("TPUDDP_NUM_PROCESSES"):
+        rdv["num_processes"] = env["TPUDDP_NUM_PROCESSES"]
+    if env.get("TPUDDP_PROCESS_ID"):
+        rdv["process_id"] = env["TPUDDP_PROCESS_ID"]
+
+    out: Dict[str, Any] = {}
+    if rdv.get("coordinator_address"):
+        out["coordinator_address"] = str(rdv["coordinator_address"])
+    if rdv.get("num_processes") is not None:
+        out["num_processes"] = int(rdv["num_processes"])
+    if rdv.get("process_id") is not None:
+        out["process_id"] = int(rdv["process_id"])
+    unknown = set(rdv) - {"coordinator_address", "num_processes", "process_id"}
+    if unknown:
+        raise ValueError(
+            f"unknown local.rendezvous keys {sorted(unknown)}; expected "
+            "coordinator_address, num_processes, process_id"
+        )
+    if out.get("coordinator_address") and out.get("num_processes", 1) > 1:
+        if "process_id" not in out:
+            raise ValueError(
+                "local.rendezvous with num_processes > 1 needs a process_id "
+                "(set TPUDDP_PROCESS_ID per host, or the YAML key)"
+            )
+    return out
+
+
 def optional_args_from(settings: Dict[str, Any]) -> Dict[str, Any]:
     return dict(settings.get("optional_args") or {})
 

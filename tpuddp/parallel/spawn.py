@@ -61,6 +61,37 @@ def maybe_reexec_for_world(world_size: int, backend: Optional[str] = None) -> No
     os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
 
 
+def maybe_reexec_for_multihost_world(
+    world_size: Optional[int],
+    num_processes: int,
+    backend: Optional[str] = None,
+) -> None:
+    """Multi-host flavor of the dev launcher. Decides from the *environment
+    only* — probing ``jax.devices()`` here would initialize XLA before
+    ``jax.distributed.initialize`` runs in :func:`backend.setup`, which JAX
+    forbids. Each process re-execs itself with enough virtual CPU devices for
+    its share (world_size // num_processes) of the global world."""
+    prefer = backend or os.environ.get(_backend._BACKEND_ENV)
+    if prefer != "cpu" or not world_size or num_processes <= 1:
+        return
+    local = max(1, world_size // num_processes)
+    flag = f"--xla_force_host_platform_device_count={local}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if flag in flags:
+        return
+    if os.environ.get(_REEXEC_GUARD):
+        raise RuntimeError(
+            f"re-exec with {flag} did not stick; XLA_FLAGS={flags!r}"
+        )
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = f"{flags} {flag}".strip()
+    env[_REEXEC_GUARD] = "1"
+    logger.info(
+        "re-exec for %d-local-device CPU world (%d processes)", local, num_processes
+    )
+    os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+
+
 def run_ddp_training(
     demo_fn: Callable,
     world_size: Optional[int],
@@ -78,7 +109,11 @@ def run_ddp_training(
     process; rank is the process index (0 on single host). Exceptions
     propagate like mp.spawn(join=True).
     """
-    if world_size is not None:
+    multihost = coordinator_address is not None and (num_processes or 1) > 1
+    if multihost:
+        # env-only decision: XLA must stay uninitialized until the rendezvous
+        maybe_reexec_for_multihost_world(world_size, num_processes, backend)
+    elif world_size is not None:
         maybe_reexec_for_world(world_size, backend)
     _backend.setup(
         world_size=world_size,

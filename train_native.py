@@ -154,6 +154,9 @@ if __name__ == "__main__":
     world_size = cfg_lib.world_size_from(settings)
     optional_args = cfg_lib.optional_args_from(settings)
     training = cfg_lib.training_config(settings)
+    # multi-host rendezvous (local.rendezvous / TPUDDP_* env) — the analog of
+    # the reference's MASTER_ADDR:MASTER_PORT (multi-GPU-training-torch.py:30-31)
+    rendezvous = cfg_lib.rendezvous_from(settings)
 
     run_ddp_training(
         partial(basic_ddp_training_loop, training=training),
@@ -161,4 +164,5 @@ if __name__ == "__main__":
         out_dir,
         optional_args,
         backend=cfg_lib.device_from(settings),
+        **rendezvous,
     )
