@@ -84,17 +84,18 @@ def _peak_flops():
     return PEAK_FLOPS.get(kind), kind
 
 
-def _record(name, sps_per_chip, ms_per_step, flops_per_step, n_chips, steps_per_call=1):
+def _record(name, sps_per_chip, ms_per_step, flops_per_chip_step, extra=None):
     peak, _ = _peak_flops()
     mfu = None
-    if flops_per_step and peak:
-        # flops_per_step is whole-program (all chips); per-chip time is wall
-        mfu = (flops_per_step / n_chips) / (ms_per_step / 1e3) / peak
+    if flops_per_chip_step and peak:
+        mfu = flops_per_chip_step / (ms_per_step / 1e3) / peak
     RESULTS[name] = {
         "samples_per_sec_per_chip": round(sps_per_chip, 1),
         "ms_per_step": round(ms_per_step, 3),
         "mfu": round(mfu, 4) if mfu is not None else None,
     }
+    if extra:
+        RESULTS[name].update(extra)
     mfu_s = f", MFU {mfu * 100:.1f}%" if mfu is not None else ""
     log(f"{name}: {sps_per_chip:,.0f} samples/s/chip, {ms_per_step:.2f} ms/step{mfu_s}")
 
@@ -175,39 +176,81 @@ def bench_config(
     steps = run(steps)
     dt = time.perf_counter() - t0
 
-    # FLOPs of the step actually timed. XLA's cost analysis counts a
-    # while/scan body ONCE regardless of trip count (verified empirically:
-    # scan-program flops = 1.00-1.01x the single-step program for K=4..16),
-    # so the scan program's total IS the per-step figure.
-    flops_per_step = None
+    # FLOPs of the step actually timed, cross-checked at runtime rather than
+    # assumed (two backend/version-dependent conventions could each skew the
+    # published MFU by Kx or Nx):
+    #  1. scan counting: XLA's cost analysis counts a while/scan body once in
+    #     most versions (scan-program flops ~= single-step program flops); if
+    #     this backend instead counts the body K times, the ratio test below
+    #     detects it and divides by K. Anything else -> MFU suppressed.
+    #  2. chip counting: the figure may be whole-program or per-device. With
+    #     n_chips > 1 a 1-device probe of the same per-chip workload
+    #     disambiguates; an unresolvable ratio -> MFU suppressed.
+    flops_note = None
+    flops_per_chip = None
     try:
-        if scan > 1:
+        bx, by, bw = batch
+        f_single = _program_flops(
+            jax.jit(lambda s, a, b, c: ddp.train_step(s, (a, b, c))),
+            state_box[0], bx, by, bw,
+        )
+        f_step = f_single
+        if scan > 1 and f_single:
             stacked = ddp.shard_stacked(
                 stack_batches([tuple(np.asarray(b) for b in batch)] * scan)
             )
             xs, ys, ws = stacked
-            flops_per_step = _program_flops(
+            f_scan = _program_flops(
                 jax.jit(lambda s, a, b, c: ddp.train_step_many(s, (a, b, c))),
                 state_box[0], xs, ys, ws,
             )
-        else:
-            bx, by, bw = batch
-            flops_per_step = _program_flops(
-                jax.jit(lambda s, a, b, c: ddp.train_step(s, (a, b, c))),
-                state_box[0], bx, by, bw,
+            ratio = (f_scan or 0.0) / f_single
+            if 0.75 <= ratio <= 1.33:
+                f_step = f_scan  # body counted once (the usual convention)
+            elif abs(ratio - scan) / scan <= 0.33:
+                f_step = f_scan / scan  # body counted per trip
+            else:
+                f_step = None
+                flops_note = f"scan/single flops ratio {ratio:.2f} unresolvable"
+                log(f"  MFU suppressed: {flops_note}")
+        if f_step and n_chips > 1:
+            # Disambiguate whole-program vs per-device module flops.
+            from tpuddp.parallel import make_mesh as _mk
+            ddp1 = DistributedDataParallel(
+                model, optim.Adam(1e-3), nn.CrossEntropyLoss(),
+                mesh=_mk(devices[:1]), mode="shard_map", augment=augment,
             )
+            b1 = ddp1.shard((x[:batch_per_chip], y[:batch_per_chip], w[:batch_per_chip]))
+            f_1dev = _program_flops(
+                jax.jit(lambda s, a, b, c: ddp1.train_step(s, (a, b, c))),
+                state_box[0], *b1,
+            )
+            if f_1dev:
+                r = f_step / f_1dev
+                if abs(r - n_chips) / n_chips <= 0.25:
+                    flops_per_chip = f_step / n_chips  # whole-program figure
+                elif 0.75 <= r <= 1.33:
+                    flops_per_chip = f_step  # per-device figure
+                else:
+                    flops_note = f"{n_chips}-chip/1-chip flops ratio {r:.2f} unresolvable"
+                    log(f"  MFU suppressed: {flops_note}")
+        elif f_step:
+            flops_per_chip = f_step
     except Exception as e:
         log(f"  flops probe failed ({type(e).__name__}: {e})")
 
     sps = steps * global_batch / dt
-    _record(name, sps / n_chips, dt / steps * 1e3, flops_per_step, n_chips)
+    extra = {"mfu_note": flops_note} if flops_note else None
+    _record(name, sps / n_chips, dt / steps * 1e3, flops_per_chip, extra)
     return sps / n_chips, n_chips
 
 
-def bench_managed(batch_per_chip=128, steps=60, deferred=False):
+def bench_managed(batch_per_chip=128, steps=60, deferred=False, fuse=1):
     """The managed (Accelerator) path on the toy MLP — BASELINE.json
     configs[2]. Eager mode keeps the reference's per-batch loss.item() sync
-    (quirk Q3/Q5 parity); deferred mode syncs once at the end."""
+    (quirk Q3/Q5 parity); deferred mode syncs once at the end; fuse > 1 adds
+    K-step scan fusion behind the Accelerator (the managed analog of the
+    native scan-fused path)."""
     import jax
     import jax.numpy as jnp
 
@@ -219,7 +262,7 @@ def bench_managed(batch_per_chip=128, steps=60, deferred=False):
     mesh = make_mesh(jax.devices())
     n_chips = mesh.devices.size
     global_batch = batch_per_chip * n_chips
-    acc = Accelerator(mesh=mesh, seed=0)
+    acc = Accelerator(mesh=mesh, seed=0, fuse_steps=fuse)
     model, opt = acc.prepare(ToyMLP(num_classes=10), optim.Adam(1e-3))
     criterion = nn.CrossEntropyLoss()
 
@@ -236,21 +279,29 @@ def bench_managed(batch_per_chip=128, steps=60, deferred=False):
             acc.backward(loss)
             opt.step()
             if deferred:
-                losses.append(loss.device_value())
+                losses.append(loss)  # values land when the queue flushes
             else:
                 total += loss.item()
         if deferred:
-            total = float(np.sum(jax.device_get(losses)))
+            # sum on device array-at-a-time over fused flushes; one fetch
+            from tpuddp.accelerate import sum_losses
+
+            total = float(sum_losses(losses))
         assert np.isfinite(total)
 
-    run(3)
-    run(3)
+    # warm twice with >= 2 flushes each so every program the timed run uses is
+    # compiled: the fused-scan (both pre- and post-donation operand layouts)
+    # AND sum_losses' scalar add between flush arrays
+    run(2 * max(3, fuse))
+    run(2 * max(3, fuse))
     t0 = time.perf_counter()
     run(steps)
     dt = time.perf_counter() - t0
     sps = steps * global_batch / dt
     mode = "deferred" if deferred else "eager per-batch sync"
-    _record(f"managed toy_mlp ({mode})", sps / n_chips, dt / steps * 1e3, None, n_chips)
+    if fuse > 1:
+        mode += f", {fuse}-step fused"
+    _record(f"managed toy_mlp ({mode})", sps / n_chips, dt / steps * 1e3, None)
     return sps / n_chips
 
 
@@ -340,9 +391,11 @@ def main():
         except Exception as e:
             log(f"{name} bench failed: {type(e).__name__}: {e}")
 
-    for deferred in (False, True):
+    for deferred, fuse in ((False, 1), (True, 1), (True, 32)):
         try:
-            bench_managed(deferred=deferred)
+            # steps a multiple of fuse so the timed region never compiles the
+            # remainder (single-step) program
+            bench_managed(deferred=deferred, fuse=fuse, steps=64 if fuse > 1 else 60)
         except Exception as e:
             log(f"managed bench failed: {type(e).__name__}: {e}")
 

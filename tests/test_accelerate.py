@@ -168,8 +168,10 @@ def test_deferred_metrics_matches_eager(cpu_devices):
 
     mesh = make_mesh(cpu_devices)
     results = []
-    for deferred in (False, True):
-        accel = Accelerator(mesh=mesh, seed=7)
+    # (deferred, fuse_steps): fuse=3 over 8 batches exercises two full scan
+    # flushes plus an epoch-end remainder flush triggered by the loss reads
+    for deferred, fuse in ((False, 1), (True, 1), (True, 3)):
+        accel = Accelerator(mesh=mesh, seed=7, fuse_steps=fuse)
         ds = SyntheticClassification(n=64, shape=(8, 8, 3), seed=3)
         train_loader = DataLoader(ds, batch_size=8, shuffle=True)
         test_loader = DataLoader(ds, batch_size=8)
@@ -188,3 +190,94 @@ def test_deferred_metrics_matches_eager(cpu_devices):
         )
         results.append((tr, te, pct))
     np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
+    # scan fusion must be a pure batching change: identical metrics
+    np.testing.assert_allclose(results[0], results[2], rtol=1e-5)
+
+
+def test_superseded_backward_loss_refuses_silent_recompute(acc):
+    """A loss whose pending backward was dropped (second backward before
+    step, or zero_grad) must raise rather than silently recompute with the
+    CURRENT params and a fresh RNG key."""
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(0.1))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+
+    loss1 = criterion(model(x), y)
+    acc.backward(loss1)
+    loss2 = criterion(model(x), y)
+    acc.backward(loss2)  # supersedes loss1's unexecuted backward
+    opt.step()
+    with pytest.raises(RuntimeError, match="dropped"):
+        loss1.item()
+    assert loss2.item() > 0  # the executed backward's loss is intact
+
+    loss3 = criterion(model(x), y)
+    acc.backward(loss3)
+    opt.zero_grad()  # clears the pending backward
+    with pytest.raises(RuntimeError, match="dropped"):
+        loss3.item()
+
+    # a loss read BEFORE being superseded keeps its (materialized) value
+    loss4 = criterion(model(x), y)
+    acc.backward(loss4)
+    v4 = loss4.item()
+    loss5 = criterion(model(x), y)
+    acc.backward(loss5)
+    opt.step()
+    assert loss4.item() == v4
+
+    # forward-only eval losses (no backward ever requested) still compute
+    eval_loss = criterion(model(x), y)
+    assert eval_loss.item() > 0
+
+
+def test_fuse_queue_flushes_before_params_are_read(mesh):
+    """With fuse_steps > 1, queued updates must land before any read of the
+    model: a forward, a loss read, or save_model all trigger a flush."""
+    acc = Accelerator(mesh=mesh, seed=1, fuse_steps=4)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(0.5))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+
+    model(x)  # init
+    p0 = jax.tree_util.tree_map(np.asarray, model.params)
+    losses = []
+    for _ in range(2):  # fewer than fuse_steps: stays queued
+        loss = criterion(model(x), y)
+        acc.backward(loss)
+        opt.step()
+        losses.append(loss)
+    assert len(opt._queue) == 2
+
+    # a concrete forward flushes the queue so it sees updated params
+    model.eval()
+    _ = np.asarray(model(x))
+    assert opt._queue == []
+    moved = any(
+        bool(np.any(np.asarray(a) != b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(model.params),
+            jax.tree_util.tree_leaves(p0),
+        )
+    )
+    assert moved
+    # queued losses got their values from the scan's loss stack
+    assert all(l.device_value() is not None for l in losses)
+    assert losses[0].item() != losses[1].item()
+
+
+def test_prepare_passes_drop_last_through(acc):
+    ds = SyntheticClassification(n=70, shape=(4, 4, 3))
+    loader = DataLoader(ds, batch_size=4, shuffle=True, drop_last=True)
+    prepared = acc.prepare(loader)
+    assert prepared.drop_last is True
+    # 70 samples / 8 replicas -> sampler pads to 72 -> 9 per replica;
+    # drop_last drops the partial batch of 1: 2 full batches of 4
+    assert len(prepared) == 2
+
+
+def test_accelerator_honors_num_chips_subworld(cpu_devices):
+    acc = Accelerator(num_chips=4, seed=0)
+    assert acc.mesh.devices.size == 4

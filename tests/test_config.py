@@ -122,3 +122,18 @@ def test_rendezvous_env_overrides(monkeypatch):
 def test_rendezvous_unknown_key_rejected():
     with pytest.raises(ValueError):
         cfg.rendezvous_from({"local": {"rendezvous": {"master_addr": "x"}}})
+
+
+def test_num_classes_derived_from_dataset():
+    assert cfg.num_classes_from({"dataset": "cifar10"}) == 10
+    assert cfg.num_classes_from({"dataset": "digits"}) == 10
+    assert cfg.num_classes_from({}) == 10  # default dataset is cifar10
+
+
+def test_num_classes_explicit_overrides_dataset():
+    assert cfg.num_classes_from({"dataset": "cifar10", "num_classes": 7}) == 7
+
+
+def test_num_classes_unknown_dataset_requires_explicit():
+    with pytest.raises(ValueError, match="num_classes"):
+        cfg.num_classes_from({"dataset": "imagenet21k"})

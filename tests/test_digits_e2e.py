@@ -1,0 +1,74 @@
+"""Real-data end-to-end training: the digits dataset (1,797 genuine 8x8
+handwritten digit scans, the only real image data available offline in a
+zero-egress environment) through the native entrypoint — the stand-in proof
+for the reference's real-CIFAR-10 workload (data_and_toy_model.py:8-38):
+actual generalization accuracy on held-out human-written data, exercising
+entrypoint, dataset dispatch, sharded loading, augmentation, and metrics."""
+
+import re
+from functools import partial
+
+import numpy as np
+import pytest
+
+from tpuddp.data import digits, load_datasets_for
+
+
+def test_digits_loads_real_data_with_cifar_contract():
+    train, test = digits.load_datasets()
+    assert len(train) == 1437 and len(test) == 360
+    assert train.images.dtype == np.uint8
+    assert train.images.shape[1:] == (8, 8, 3)
+    # real data: all 10 digit classes present in both splits, roughly balanced
+    for split in (train, test):
+        counts = np.bincount(split.labels, minlength=10)
+        assert counts.min() > 0.5 * counts.mean()
+    # deterministic split
+    again_train, _ = digits.load_datasets()
+    np.testing.assert_array_equal(train.labels, again_train.labels)
+
+
+def test_dataset_dispatch_selects_digits():
+    train, _ = load_datasets_for({"dataset": "digits"})
+    assert len(train) == 1437
+    with pytest.raises(ValueError, match="dataset"):
+        load_datasets_for({"dataset": "imagenet"})
+
+
+@pytest.mark.slow
+def test_digits_e2e_reaches_real_accuracy(tmp_path, capsys):
+    """4 epochs of ToyCNN on digits through the full native entrypoint must
+    reach >= 85% held-out accuracy (measured ~95%) — real generalization on
+    real data, not synthetic-cluster separation."""
+    import train_native
+    from tpuddp.parallel import backend
+    from tpuddp.parallel.spawn import run_ddp_training
+
+    training = {
+        "model": "toy_cnn",
+        "dataset": "digits",
+        "data_root": "/nonexistent",
+        "train_batch_size": 32,
+        "test_batch_size": 45,
+        "learning_rate": 0.001,
+        "num_epochs": 4,
+        "checkpoint_epoch": 10,
+        "image_size": None,
+        "seed": 0,
+        "mode": "shard_map",
+        "prefetch": False,
+        "flip": False,  # digits are not flip-invariant
+    }
+    backend.cleanup()
+    run_ddp_training(
+        partial(train_native.basic_ddp_training_loop, training=training),
+        world_size=8,
+        save_dir=str(tmp_path),
+        optional_args={"set_epoch": True},
+        backend="cpu",
+    )
+    backend.cleanup()
+    out = capsys.readouterr().out
+    accs = re.findall(r"Test Accuracy: ([0-9.]+)%", out)
+    assert accs, f"no accuracy lines in output:\n{out[-2000:]}"
+    assert float(accs[-1]) >= 85.0, f"final accuracy {accs[-1]}% < 85%"

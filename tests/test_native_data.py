@@ -1,6 +1,8 @@
 """Native C++ data path (tpuddp/data/_native) and the prefetching loader —
 both must be bit-identical to the numpy fallback."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -109,3 +111,14 @@ def test_prefetch_wraps_plain_dataloader():
     batches = list(pre)
     assert len(batches) == 3
     assert batches[-1][2].sum() == 4  # padding mask intact through the queue
+
+
+def test_native_library_path_is_isa_keyed():
+    """-march=native builds must not be shared across ISAs (SIGILL on a
+    shared filesystem): the cache filename carries a host fingerprint."""
+    from tpuddp.data import _native
+
+    tag = _native._isa_tag()
+    assert tag and "/" not in tag
+    assert tag in os.path.basename(_native._LIB)
+    assert _native._LIB.endswith(".so")

@@ -55,7 +55,7 @@ def _run_native(tmp_path, capsys, monkeypatch, datasets, training):
     from tpuddp.parallel.spawn import run_ddp_training
 
     monkeypatch.setattr(
-        train_native, "load_datasets", lambda *a, **k: datasets
+        train_native, "load_datasets_for", lambda *a, **k: datasets
     )
     backend.cleanup()
     run_ddp_training(
@@ -125,3 +125,23 @@ def test_load_pretrained_swaps_head_and_keeps_features(tmp_path):
     assert params[-1]["weight"].shape == (4096, 10)
     conv0 = donor.state_dict()["features.0.weight"].numpy().transpose(2, 3, 1, 0)
     np.testing.assert_allclose(np.asarray(params[0]["weight"]), conv0, rtol=1e-6)
+
+
+def test_pretrained_from_config_honors_num_classes(tmp_path):
+    """training.num_classes (or the dataset-derived default) sizes the swapped
+    head — a non-CIFAR config must not silently get a 10-class head."""
+    from tpuddp.models.torch_import import pretrained_from_config
+
+    torch.manual_seed(2)
+    donor = torch_alexnet(num_classes=1000)
+    path = tmp_path / "donor.pt"
+    torch.save(donor.state_dict(), str(path))
+
+    base = {"model": "alexnet", "pretrained_path": str(path),
+            "image_size": 64, "seed": 0}
+    _, params, _ = pretrained_from_config(dict(base, dataset="cifar10"))
+    assert params[-1]["weight"].shape == (4096, 10)
+    _, params, _ = pretrained_from_config(dict(base, num_classes=21))
+    assert params[-1]["weight"].shape == (4096, 21)
+    with pytest.raises(ValueError, match="num_classes"):
+        pretrained_from_config(dict(base, dataset="imagenet21k"))

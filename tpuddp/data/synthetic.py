@@ -58,3 +58,12 @@ class SyntheticClassification:
             self.from_arrays(self.images[:-n_test], self.labels[:-n_test]),
             self.from_arrays(self.images[-n_test:], self.labels[-n_test:]),
         )
+
+
+def synthetic_uint8_datasets(n_train: int = 2048, n_test: int = 512, seed: int = 0):
+    """(train, test) uint8 image datasets in the CIFAR loader's format — the
+    single source for every synthetic stand-in (the cifar10 fallback and the
+    'synthetic' dataset name must draw the same distribution)."""
+    full = SyntheticClassification(n=n_train + n_test, shape=(32, 32, 3), seed=seed)
+    full.images = np.clip(full.images * 40 + 128, 0, 255).astype(np.uint8)
+    return full.split(n_test)

@@ -7,11 +7,15 @@ from tpuddp.models.toy import ToyCNN, ToyMLP  # noqa: F401
 from tpuddp.models.alexnet import AlexNet  # noqa: F401
 from tpuddp.models.resnet import ResNet18  # noqa: F401
 
+from functools import partial as _partial
+
 _REGISTRY = {
     "toy_mlp": ToyMLP,
     "toy_cnn": ToyCNN,
     "alexnet": AlexNet,
     "resnet18": ResNet18,
+    # CIFAR-style stem (3x3 conv, no maxpool) for small native resolutions
+    "resnet18_small": _partial(ResNet18, small_input=True),
 }
 
 

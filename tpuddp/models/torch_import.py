@@ -142,8 +142,11 @@ def pretrained_from_config(training: Mapping[str, object], key=None):
         )
     if key is None:
         key = jax.random.key(int(training.get("seed") or 0))
+    from tpuddp.config import num_classes_from
+
     return load_pretrained_alexnet(
         str(training["pretrained_path"]),
         key,
+        num_classes=num_classes_from(training),
         image_size=int(training.get("image_size") or 224),
     )

@@ -23,20 +23,32 @@ import jax
 
 
 class Context:
-    """Dynamic forward-pass context."""
+    """Dynamic forward-pass context.
 
-    __slots__ = ("train", "rng", "axis_name")
+    ``sample_weight``: optional per-sample 0/1 mask aligned with batch axis 0
+    (the static-shape padding convention, tpuddp/data/loader.py) so that
+    batch-statistic layers (BatchNorm) can exclude padded rows — padding must
+    not bias running statistics (torch feeds a ragged last batch instead)."""
 
-    def __init__(self, train: bool = False, rng=None, axis_name: Optional[str] = None):
+    __slots__ = ("train", "rng", "axis_name", "sample_weight")
+
+    def __init__(
+        self,
+        train: bool = False,
+        rng=None,
+        axis_name: Optional[str] = None,
+        sample_weight=None,
+    ):
         self.train = train
         self.rng = rng
         self.axis_name = axis_name
+        self.sample_weight = sample_weight
 
     def child(self, i: int) -> "Context":
         """Context for the i-th submodule: fold the index into the key so each
         stochastic layer draws independently."""
         rng = None if self.rng is None else jax.random.fold_in(self.rng, i)
-        return Context(self.train, rng, self.axis_name)
+        return Context(self.train, rng, self.axis_name, self.sample_weight)
 
 
 def _sds(x) -> jax.ShapeDtypeStruct:

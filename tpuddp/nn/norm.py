@@ -10,6 +10,17 @@ here as two fused XLA collectives over ICI.
 
 torch-parity details kept: momentum 0.1 (new-stat weight), eps 1e-5, biased
 variance for normalization but **unbiased** variance for the running buffer.
+
+Two honesty details beyond torch:
+
+- **Padded rows are excluded from batch statistics.** tpuddp pads the final
+  partial batch to a static shape with weight-0 rows (TPU-first: no ragged
+  recompiles); when the forward ``Context`` carries ``sample_weight``, the
+  batch mean/var are weighted sums so padding cannot bias the running stats
+  (torch never sees padded rows because it feeds a ragged last batch).
+- ``stable_var=True`` computes the variance two-pass (``E[(x-mean)^2]``)
+  instead of the single-pass ``E[x^2]-E[x]^2``, which is cancellation-prone
+  for large-mean activations; sync mode then costs a second ``pmean``.
 """
 
 from __future__ import annotations
@@ -37,6 +48,7 @@ class BatchNorm(Module):
         affine: bool = True,
         track_running_stats: bool = True,
         sync: bool = False,
+        stable_var: bool = False,
         dtype=jnp.float32,
     ):
         self.momentum = momentum
@@ -44,6 +56,7 @@ class BatchNorm(Module):
         self.affine = affine
         self.track_running_stats = track_running_stats
         self.sync = sync
+        self.stable_var = stable_var
         self.dtype = dtype
 
     def init(self, key, x):
@@ -71,31 +84,85 @@ class BatchNorm(Module):
         use_batch_stats = ctx.train or not self.track_running_stats
 
         if use_batch_stats:
-            mean = jnp.mean(x, axis=reduce_axes)
-            mean_sq = jnp.mean(jnp.square(x), axis=reduce_axes)
-            n = x.size // x.shape[-1]
-            if self.sync and ctx.axis_name is not None:
-                mean = lax.pmean(mean, ctx.axis_name)
-                mean_sq = lax.pmean(mean_sq, ctx.axis_name)
-                n = n * lax.axis_size(ctx.axis_name)
-            var = mean_sq - jnp.square(mean)  # biased, used for normalization
+            xs = x.astype(self.dtype)  # stats accumulate in f32 even for bf16
+            ax = ctx.axis_name if self.sync else None
+            w = ctx.sample_weight
+            if w is not None:
+                # padded (weight-0) rows are excluded from the statistics
+                wb = jnp.reshape(
+                    w.astype(self.dtype), (-1,) + (1,) * (x.ndim - 1)
+                )
+                spatial = x.size // (x.shape[0] * x.shape[-1])
+                count = jnp.sum(wb) * spatial
+                sum_x = jnp.sum(xs * wb, axis=reduce_axes)
+            else:
+                wb = None
+                count = jnp.asarray(float(x.size // x.shape[-1]), self.dtype)
+                sum_x = jnp.sum(xs, axis=reduce_axes)
+
+            if self.stable_var:
+                # two-pass: mean first, then E[(x-mean)^2] — no cancellation
+                if ax is not None:
+                    sum_x, count = lax.pmean((sum_x, count), ax)
+                denom = jnp.maximum(count, 1.0)
+                mean = sum_x / denom
+                dev = jnp.square(xs - mean)
+                sum_dev = jnp.sum(
+                    dev * wb if wb is not None else dev, axis=reduce_axes
+                )
+                if ax is not None:
+                    sum_dev = lax.pmean(sum_dev, ax)
+                var = sum_dev / denom  # biased, used for normalization
+            else:
+                xsq = jnp.square(xs)
+                sum_x2 = jnp.sum(
+                    xsq * wb if wb is not None else xsq, axis=reduce_axes
+                )
+                if ax is not None:
+                    sum_x, sum_x2, count = lax.pmean((sum_x, sum_x2, count), ax)
+                denom = jnp.maximum(count, 1.0)
+                mean = sum_x / denom
+                var = sum_x2 / denom - jnp.square(mean)  # biased
+
             new_state = state
             if self.track_running_stats and ctx.train:
                 m = self.momentum
-                unbiased = var * (n / max(n - 1, 1))
+                # total element count behind the stats (all replicas when sync)
+                n = denom * (lax.axis_size(ax) if ax is not None else 1)
+                unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
+                # a fully-padded (count==0) shard must leave the running
+                # buffers untouched, not decay them toward mean=0/var=0
+                has_data = count > 0
                 new_state = {
-                    "mean": (1 - m) * state["mean"] + m * mean,
-                    "var": (1 - m) * state["var"] + m * unbiased,
+                    "mean": jnp.where(
+                        has_data, (1 - m) * state["mean"] + m * mean, state["mean"]
+                    ),
+                    "var": jnp.where(
+                        has_data, (1 - m) * state["var"] + m * unbiased, state["var"]
+                    ),
                 }
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
+            xs = x.astype(self.dtype)
 
         inv = lax.rsqrt(var + self.eps)
-        y = (x - mean) * inv
+        y = (xs - mean) * inv
         if self.affine:
             y = y * params["scale"] + params["bias"]
         return y.astype(x.dtype), new_state
+
+
+def has_divergent_buffers(module: Module) -> bool:
+    """True when the module tree contains a buffer that *diverges across
+    replicas* under data parallelism: a stateful (``track_running_stats``)
+    BatchNorm whose statistics are not cross-replica synced. Used by the DDP
+    step builder to refuse ``sync_buffers="none"`` configs that would publish
+    per-replica-divergent buffers as replicated."""
+    if isinstance(module, BatchNorm):
+        if module.track_running_stats and not module.sync:
+            return True
+    return any(has_divergent_buffers(c) for c in module.children())
 
 
 def convert_sync_batchnorm(module: Module) -> Module:

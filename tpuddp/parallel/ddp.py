@@ -51,6 +51,11 @@ class DistributedDataParallel:
         self.criterion = criterion if criterion is not None else CrossEntropyLoss()
         self.mesh = mesh if mesh is not None else data_mesh()
         self.mode = mode
+        # fail at wrap time, not first step (a bad value would silently skip
+        # buffer sync and publish divergent buffers as replicated)
+        step_lib._validate_sync_buffers(
+            model, step_lib.DATA_AXIS if mode == "shard_map" else None, sync_buffers
+        )
         self.sync_buffers = sync_buffers
         self.clip_grad_norm = clip_grad_norm
         self.augment = augment
@@ -59,6 +64,7 @@ class DistributedDataParallel:
         self._train_step = None
         self._eval_step = None
         self._scan_step = None
+        self._eval_scan_step = None
 
     # -- world introspection (dist.get_world_size analog) -------------------
     @property
@@ -145,6 +151,19 @@ class DistributedDataParallel:
                 remat=self.remat,
             )
         return self._train_step(state, batch)
+
+    def eval_step_many(self, state: TrainState, stacked_batch):
+        """K fused eval batches per dispatch (lax.scan; see
+        training.step.build_eval_scan_step)."""
+        if self._eval_scan_step is None:
+            self._eval_scan_step = step_lib.build_eval_scan_step(
+                self.model,
+                self.criterion,
+                self.mesh,
+                mode=self.mode,
+                transform=self.eval_transform,
+            )
+        return self._eval_scan_step(state, stacked_batch)
 
     def eval_step(self, state: TrainState, batch):
         if self._eval_step is None:

@@ -77,6 +77,11 @@ def run_training_loop(
     batches (ShardedDataLoader for DP; see tpuddp.data.loader).
     """
     is_main = jax.process_index() == 0
+    eval_scan_steps = (
+        resolve_scan_steps(scan_steps, len(test_loader))
+        if hasattr(ddp, "eval_step_many")
+        else 1
+    )
     scan_steps = resolve_scan_steps(scan_steps, len(train_loader))
     history = []
     metrics_writer = MetricsWriter(save_dir)
@@ -133,11 +138,29 @@ def run_training_loop(
             state, metrics = ddp.train_step(state, ddp.shard(host_batch))
             train_acc = accumulate_metrics(train_acc, metrics)
 
-        # ---- eval pass ----
+        # ---- eval pass (same K-fused dispatch + upload lookahead as train;
+        # without it the eval epoch is per-batch dispatch-bound) ----
         eval_acc = None
+        chunk = []
+        staged = None
         for host_batch in test_loader:
-            batch = ddp.shard(host_batch)
-            metrics = ddp.eval_step(state, batch)
+            if eval_scan_steps <= 1:
+                metrics = ddp.eval_step(state, ddp.shard(host_batch))
+                eval_acc = accumulate_metrics(eval_acc, metrics)
+                continue
+            chunk.append(host_batch)
+            if len(chunk) == eval_scan_steps:
+                next_staged = ddp.shard_stacked(stack_batches(chunk))
+                chunk = []
+                if staged is not None:
+                    metrics = ddp.eval_step_many(state, staged)
+                    eval_acc = accumulate_metrics(eval_acc, metrics)
+                staged = next_staged
+        if staged is not None:
+            metrics = ddp.eval_step_many(state, staged)
+            eval_acc = accumulate_metrics(eval_acc, metrics)
+        for host_batch in chunk:  # remainder: single steps, same semantics
+            metrics = ddp.eval_step(state, ddp.shard(host_batch))
             eval_acc = accumulate_metrics(eval_acc, metrics)
 
         if train_acc is None:

@@ -53,6 +53,31 @@ def _split_step_rng(state: TrainState, axis_name: Optional[str]):
     return jax.random.split(rng)
 
 
+_SYNC_BUFFER_MODES = ("broadcast", "pmean", "none")
+
+
+def _validate_sync_buffers(model, axis_name: Optional[str], sync_buffers: str):
+    """Build-time honesty check: the shard_map step publishes ``model_state``
+    with a replicated out_spec, so any config that would let per-replica
+    buffers diverge silently must be refused here, not discovered as a wrong
+    checkpoint later."""
+    if sync_buffers not in _SYNC_BUFFER_MODES:
+        raise ValueError(
+            f"unknown sync_buffers {sync_buffers!r}; one of {_SYNC_BUFFER_MODES}"
+        )
+    if axis_name is not None and sync_buffers == "none":
+        from tpuddp.nn.norm import has_divergent_buffers
+
+        if has_divergent_buffers(model):
+            raise ValueError(
+                'sync_buffers="none" with an unsynced stateful BatchNorm: '
+                "per-replica running statistics would diverge but be "
+                "published as replicated state. Use sync_buffers='broadcast' "
+                "(torch DDP's broadcast_buffers=True default), 'pmean', or "
+                "convert_sync_batchnorm(model)."
+            )
+
+
 def _make_train_core(
     model,
     criterion,
@@ -63,6 +88,7 @@ def _make_train_core(
     augment: Optional[Callable],
     remat: bool = False,
 ):
+    _validate_sync_buffers(model, axis_name, sync_buffers)
     # Rematerialization: trade FLOPs for HBM by recomputing activations in the
     # backward pass (jax.checkpoint) — how large models/batches fit on-chip.
     apply_fn = model.apply
@@ -80,7 +106,11 @@ def _make_train_core(
             x = augment(aug_rng, x)
 
         def loss_fn(params):
-            ctx = Context(train=True, rng=dropout_rng, axis_name=axis_name)
+            # sample_weight masks padded rows out of BatchNorm statistics,
+            # not just loss/metrics (see nn/norm.py)
+            ctx = Context(
+                train=True, rng=dropout_rng, axis_name=axis_name, sample_weight=w
+            )
             logits, model_state = apply_fn(params, state.model_state, x, ctx)
             loss = criterion(logits, y, w)
             return loss, model_state
@@ -104,6 +134,10 @@ def _make_train_core(
             # torch DDP's default broadcast_buffers=True: unsynced BN buffers
             # follow rank 0. Synced BN already produced identical buffers.
             model_state = col.broadcast(model_state, root=0, axis_name=axis_name)
+        elif axis_name is not None and sync_buffers == "pmean":
+            # average instead of rank-0-wins: every replica's statistics
+            # contribute (identical when BN is already synced)
+            model_state = col.pmean(model_state, axis_name)
 
         n = jnp.sum(w)
         metrics = {
@@ -126,7 +160,7 @@ def _make_eval_core(model, criterion, axis_name, transform: Optional[Callable]):
     def core(state: TrainState, x, y, w):
         if transform is not None:
             x = transform(x)
-        ctx = Context(train=False, rng=None, axis_name=axis_name)
+        ctx = Context(train=False, rng=None, axis_name=axis_name, sample_weight=w)
         logits, _ = model.apply(state.params, state.model_state, x, ctx)
         loss = criterion(logits, y, w)
         n = jnp.sum(w)
@@ -298,6 +332,57 @@ def build_eval_step(
     def step(state, batch):
         x, y, w = batch
         return jitted(state, x, y, w)
+
+    return step
+
+
+def build_eval_scan_step(
+    model,
+    criterion,
+    mesh,
+    mode: str = "shard_map",
+    transform: Optional[Callable] = None,
+):
+    """Multi-batch eval variant: K eval batches per jit call via ``lax.scan``
+    over a ``(K, batch, ...)`` stack, returning summed metrics — the eval-pass
+    analog of :func:`build_train_scan_step` (without it the eval epoch is
+    per-batch dispatch-bound, reference warm loop
+    multi-GPU-training-torch.py:136-153)."""
+    if mode == "shard_map":
+        core = _make_eval_core(model, criterion, DATA_AXIS, transform)
+    elif mode == "auto":
+        core = _make_eval_core(model, criterion, None, transform)
+    else:
+        raise ValueError(f"unknown mode {mode!r}; one of 'shard_map', 'auto'")
+
+    def multi(state: TrainState, xs, ys, ws):
+        def body(carry, batch):
+            return carry, core(state, *batch)
+
+        _, stacked = jax.lax.scan(body, 0, (xs, ys, ws))
+        return jax.tree_util.tree_map(lambda a: jnp.sum(a, axis=0), stacked)
+
+    if mode == "shard_map":
+        in_batch = P(None, DATA_AXIS)
+        fn = jax.shard_map(
+            multi,
+            mesh=mesh,
+            in_specs=(P(), in_batch, in_batch, in_batch),
+            out_specs={
+                "loss_sum": P(DATA_AXIS),
+                "correct": P(DATA_AXIS),
+                "n": P(DATA_AXIS),
+            },
+            check_vma=False,
+        )
+        jitted = jax.jit(fn)
+    else:
+        rep, sh = replicated(mesh), NamedSharding(mesh, P(None, DATA_AXIS))
+        jitted = jax.jit(multi, in_shardings=(rep, sh, sh, sh), out_shardings=rep)
+
+    def step(state, stacked_batch):
+        xs, ys, ws = stacked_batch
+        return jitted(state, xs, ys, ws)
 
     return step
 

@@ -23,8 +23,7 @@ import numpy as np
 from tpuddp import config as cfg_lib
 from tpuddp import nn, optim
 from tpuddp.accelerate import Accelerator
-from tpuddp.data import DataLoader
-from tpuddp.data.cifar10 import load_datasets
+from tpuddp.data import DataLoader, load_datasets_for, norm_stats_for
 from tpuddp.data.transforms import make_eval_transform, make_train_augment
 
 logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -33,9 +32,7 @@ logging.basicConfig(level=logging.INFO, format="%(message)s")
 def setup_dataloaders(training):
     """Plain, distribution-unaware loaders (reference :22-36); prepare() later
     re-creates the train loader sharded."""
-    train_dataset, test_dataset = load_datasets(
-        training["data_root"], synthetic_fallback=True
-    )
+    train_dataset, test_dataset = load_datasets_for(training)
     train_loader = DataLoader(
         train_dataset, batch_size=training["train_batch_size"], shuffle=True
     )
@@ -67,11 +64,19 @@ def train(
         optimizer.step()
 
         if deferred:
-            batch_losses.append(loss.device_value())
+            # collect the LazyLoss objects; values materialize when the
+            # fuse_steps queue flushes (reading device_value here would
+            # force a flush per batch and defeat the fusion)
+            batch_losses.append(loss)
         else:
             running_loss += loss.item()  # per-batch host sync (Q5 parity mode)
     if deferred:
-        running_loss = float(np.sum(jax.device_get(batch_losses)))
+        # Sum on device (array-at-a-time over fused flushes), ONE host fetch
+        # — per-batch scalar reads cost a dispatch each and dominate the
+        # steps themselves on dispatch-latency-bound runtimes.
+        from tpuddp.accelerate import sum_losses
+
+        running_loss = float(sum_losses(batch_losses))
     return running_loss / len(train_loader)
 
 
@@ -87,23 +92,27 @@ def evaluate(model, test_loader, criterion, device, transform, deferred=False):
     correct = 0
     total = 0
     test_loss = 0.0
-    device_stats = []
+    device_stats = None
     for inputs, labels, weights in test_loader:
         inputs = transform_host(transform, inputs)
         outputs = model(inputs)
         loss = criterion(outputs, labels, weights)
         if deferred:
             # accumulate (loss, n_correct, n) as device scalars; one transfer
-            # at epoch end instead of three syncs per batch
+            # at epoch end instead of three syncs per batch. Scalar-add chains
+            # reuse one cached program regardless of epoch length.
             predicted = outputs.argmax(axis=-1)
             labels_d = jnp.asarray(labels)
             mask_d = jnp.asarray(weights) > 0
-            device_stats.append(
-                (
-                    loss.device_value(),
-                    ((predicted == labels_d) & mask_d).sum(),
-                    mask_d.sum(),
-                )
+            stat = (
+                loss.device_value(),
+                ((predicted == labels_d) & mask_d).sum(),
+                mask_d.sum(),
+            )
+            device_stats = (
+                stat
+                if device_stats is None
+                else tuple(a + b for a, b in zip(device_stats, stat))
             )
         else:
             test_loss += loss.item()
@@ -112,10 +121,9 @@ def evaluate(model, test_loader, criterion, device, transform, deferred=False):
             total += int(mask.sum())
             correct += int(((predicted == labels) & mask).sum())
     if deferred:
-        stats = jax.device_get(device_stats)
-        test_loss = float(np.sum([s[0] for s in stats]))
-        correct = int(np.sum([s[1] for s in stats]))
-        total = int(np.sum([s[2] for s in stats]))
+        # one fetch for the three accumulated device scalars
+        sums = jax.device_get(device_stats)
+        test_loss, correct, total = float(sums[0]), int(sums[1]), int(sums[2])
     accuracy = 100 * correct / total
     return test_loss / len(test_loader), accuracy
 
@@ -172,10 +180,18 @@ def run_training_loop(
     print("Finished Training.")
 
 
-def basic_accelerate_training(out_dir: str, training=None):
+def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
     training = training or cfg_lib.TRAINING_DEFAULTS
-    # Topology discovery happens inside the Accelerator (reference :115).
-    accelerator = Accelerator(seed=training.get("seed"))
+    # Topology discovery happens inside the Accelerator (reference :115);
+    # num_chips honors a configured sub-world on multi-chip hosts.
+    # fuse_steps batches K optimizer.step()s into one scan dispatch; it only
+    # pays off when loss reads are deferred, so "auto" keys off that.
+    fuse = training.get("fuse_steps", "auto")
+    if fuse in (None, "auto"):
+        fuse = 8 if training.get("deferred_metrics") else 1
+    accelerator = Accelerator(
+        seed=training.get("seed"), fuse_steps=int(fuse), num_chips=num_chips
+    )
 
     # Data + model (reference :118-122); placement is implicit on this path.
     train_loader, test_loader = setup_dataloaders(training)
@@ -191,9 +207,20 @@ def basic_accelerate_training(out_dir: str, training=None):
         model, optimizer, train_loader
     )
 
-    # jitted so each runs as one fused device op, not eager op-by-op
-    augment = jax.jit(make_train_augment(size=training.get("image_size")))
-    eval_transform = jax.jit(make_eval_transform(size=training.get("image_size")))
+    # jitted so each runs as one fused device op, not eager op-by-op;
+    # normalization stats follow the dataset, flip is a config knob
+    mean, std = norm_stats_for(training)
+    augment = jax.jit(
+        make_train_augment(
+            size=training.get("image_size"),
+            flip=bool(training.get("flip", True)),
+            mean=mean,
+            std=std,
+        )
+    )
+    eval_transform = jax.jit(
+        make_eval_transform(size=training.get("image_size"), mean=mean, std=std)
+    )
     run_training_loop(
         model,
         training_dataloader,
@@ -213,6 +240,8 @@ def basic_accelerate_training(out_dir: str, training=None):
 def load_model_for(training):
     from tpuddp.models import load_model
 
+    from tpuddp.config import num_classes_from
+
     if training.get("pretrained_path"):
         from tpuddp.models.torch_import import pretrained_from_config
 
@@ -220,7 +249,7 @@ def load_model_for(training):
         # consumed by PreparedModel._ensure_init instead of a fresh init
         model._tpuddp_initial_variables = (params, mstate)
     else:
-        model = load_model(training["model"])
+        model = load_model(training["model"], num_classes_from(training))
     if training.get("sync_bn"):
         nn.convert_sync_batchnorm(model)
     return model
@@ -245,11 +274,12 @@ if __name__ == "__main__":
     training = cfg_lib.training_config(settings)
 
     # Managed path: world size comes from the runtime, not config — but honor
-    # the dev-mode CPU world request like the native entrypoint does.
+    # the dev-mode CPU world request like the native entrypoint does, and a
+    # configured sub-world (local.tpu.num_chips) on multi-chip hosts.
     world_size = cfg_lib.world_size_from(settings)
     if world_size:
         from tpuddp.parallel.spawn import maybe_reexec_for_world
 
         maybe_reexec_for_world(world_size, cfg_lib.device_from(settings))
 
-    basic_accelerate_training(out_dir, training)
+    basic_accelerate_training(out_dir, training, num_chips=world_size)

@@ -25,8 +25,7 @@ import jax.numpy as jnp
 
 from tpuddp import config as cfg_lib
 from tpuddp import nn, optim, seeding
-from tpuddp.data import PrefetchLoader, ShardedDataLoader
-from tpuddp.data.cifar10 import load_datasets
+from tpuddp.data import PrefetchLoader, ShardedDataLoader, load_datasets_for, norm_stats_for
 from tpuddp.data.transforms import make_eval_transform, make_train_augment
 from tpuddp.models import load_model
 from tpuddp.parallel.ddp import DistributedDataParallel
@@ -52,12 +51,7 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
 
     # Data + model (reference :237-238); synthetic fallback keeps the tutorial
     # runnable with no dataset staged (zero-egress environments).
-    load_kwargs = {}
-    if training.get("synthetic_n"):  # synthetic stand-in sizing (benchmarks/CI)
-        load_kwargs["synthetic_n"] = tuple(training["synthetic_n"])
-    train_ds, test_ds = load_datasets(
-        training["data_root"], synthetic_fallback=True, **load_kwargs
-    )
+    train_ds, test_ds = load_datasets_for(training)
     train_loader = ShardedDataLoader(
         train_ds, training["train_batch_size"], mesh, shuffle=True
     )
@@ -70,10 +64,15 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         train_loader = PrefetchLoader(train_loader)
         test_loader = PrefetchLoader(test_loader)
 
-    # Device-side transform pipeline (replaces data_and_toy_model.py:13-29).
+    # Device-side transform pipeline (replaces data_and_toy_model.py:13-29);
+    # normalization stats follow the dataset, and flip is a config knob
+    # (digits are not flip-invariant, unlike CIFAR photos).
     size = training.get("image_size")
-    augment = make_train_augment(size=size)
-    eval_transform = make_eval_transform(size=size)
+    mean, std = norm_stats_for(training)
+    augment = make_train_augment(
+        size=size, flip=bool(training.get("flip", True)), mean=mean, std=std
+    )
+    eval_transform = make_eval_transform(size=size, mean=mean, std=std)
 
     # Model, optionally fine-tuning from a torch checkpoint on disk — the
     # reference's central pretrained-AlexNet workflow (data_and_toy_model.py:41-45).
@@ -84,7 +83,7 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         model, init_params, init_mstate = pretrained_from_config(training, key)
         print(f"Loaded pretrained AlexNet weights from {training['pretrained_path']}.")
     else:
-        model = load_model(training["model"])
+        model = load_model(training["model"], cfg_lib.num_classes_from(training))
     if training.get("sync_bn"):
         nn.convert_sync_batchnorm(model)
 
