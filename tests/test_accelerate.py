@@ -281,3 +281,29 @@ def test_prepare_passes_drop_last_through(acc):
 def test_accelerator_honors_num_chips_subworld(cpu_devices):
     acc = Accelerator(num_chips=4, seed=0)
     assert acc.mesh.devices.size == 4
+
+
+def test_params_read_flushes_fuse_queue(mesh):
+    """A direct model.params read (weight-norm logging, gather) must never
+    see values that are K queued updates stale."""
+    acc = Accelerator(mesh=mesh, seed=2, fuse_steps=4)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(0.5))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+    model(x)
+    p0 = jax.tree_util.tree_map(np.asarray, model.params)
+    for _ in range(2):
+        loss = criterion(model(x), y)
+        acc.backward(loss)
+        opt.step()
+    assert len(opt._queue) == 2
+    p_now = model.params  # property read flushes
+    assert opt._queue == []
+    moved = any(
+        bool(np.any(np.asarray(a) != b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_now), jax.tree_util.tree_leaves(p0)
+        )
+    )
+    assert moved

@@ -72,3 +72,13 @@ def test_digits_e2e_reaches_real_accuracy(tmp_path, capsys):
     accs = re.findall(r"Test Accuracy: ([0-9.]+)%", out)
     assert accs, f"no accuracy lines in output:\n{out[-2000:]}"
     assert float(accs[-1]) >= 85.0, f"final accuracy {accs[-1]}% < 85%"
+
+
+def test_flip_default_follows_dataset():
+    from tpuddp.data import flip_for
+
+    assert flip_for({"dataset": "cifar10"}) is True
+    assert flip_for({}) is True
+    assert flip_for({"dataset": "digits"}) is False
+    assert flip_for({"dataset": "digits", "flip": True}) is True
+    assert flip_for({"dataset": "cifar10", "flip": False}) is False

@@ -1,0 +1,91 @@
+"""Categorize a captured XLA/TPU profiler trace into a per-component device
+time breakdown (the analysis behind BASELINE.md's MFU section).
+
+Usage:
+    TPUDDP_PROFILE=<dir> python train_native.py --settings_file ...   # capture
+    python tools/trace_breakdown.py <dir>                              # analyze
+
+Works on the trace-viewer JSON the profiler writes (vm.trace.json.gz); does
+not need the tensorboard profile plugin (whose converter does not match the
+installed TF build). Buckets each device op by its `source`/`tf_op`/shape
+metadata into: matmul/conv compute, optimizer+weight HBM traffic,
+augment/resize, copies/slices, other elementwise.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import sys
+
+
+def load_ops(trace_dir: str):
+    pattern = f"{trace_dir}/**/*.trace.json.gz"
+    files = sorted(glob.glob(pattern, recursive=True))
+    if not files:
+        raise SystemExit(f"no *.trace.json.gz under {trace_dir}")
+    with gzip.open(files[-1]) as fh:
+        data = json.load(fh)
+    events = data["traceEvents"]
+    tids = {}
+    device_pids = set()
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tids[(e["pid"], e["tid"])] = e["args"]["name"]
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            if "TPU" in e["args"].get("name", ""):
+                device_pids.add(e["pid"])
+    return [
+        e
+        for e in events
+        if e.get("ph") == "X"
+        and e["pid"] in device_pids
+        and tids.get((e["pid"], e["tid"])) == "XLA Ops"
+        and not e["name"].startswith("while")
+    ]
+
+
+def categorize(e) -> str:
+    a = e.get("args") or {}
+    src, tf_op = a.get("source", ""), a.get("tf_op", "")
+    swl = a.get("shape_with_layout", "")
+    if "transforms.py" in src or "_resize" in tf_op:
+        return "augment/resize"
+    # an op whose output tuple repeats a large weight shape is the fused
+    # optimizer update (param, m, v) riding on the weight-grad dot
+    if "optim" in src or any(
+        swl.count(s) >= 2
+        for s in ("f32[9216,4096]", "f32[4096,4096]", "f32[4096,10]")
+    ):
+        return "optimizer+weight traffic"
+    if "conv" in tf_op or "dot_general" in tf_op:
+        return "matmul/conv compute"
+    if "copy" in e["name"] or "slice" in e["name"]:
+        return "copies/slices"
+    return "other elementwise"
+
+
+def main(trace_dir: str, steps: int = 0):
+    ops = load_ops(trace_dir)
+    total = sum(e["dur"] for e in ops)
+    by = collections.Counter()
+    flops = collections.Counter()
+    for e in ops:
+        k = categorize(e)
+        by[k] += e["dur"]
+        flops[k] += float((e.get("args") or {}).get("model_flops", 0) or 0)
+    per_step = f" ({total / steps / 1e3:.2f} ms/step)" if steps else ""
+    print(f"device op time {total / 1e3:.1f} ms{per_step}")
+    for k, d in by.most_common():
+        print(
+            f"  {k:26s} {d / 1e3:8.1f} ms  {100 * d / total:5.1f}%  "
+            f"{flops[k] / 1e12:6.2f} TF"
+        )
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    main(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 0)

@@ -174,8 +174,8 @@ class PreparedModel:
     def __init__(self, accelerator: "Accelerator", module: Module):
         self.accelerator = accelerator
         self.module = module
-        self.params = None
-        self.model_state = None
+        self._params = None
+        self._model_state = None
         self._training = True
         self._grad_step = None
         self._fused_step = None
@@ -196,8 +196,32 @@ class PreparedModel:
         self._training = False
         return self
 
+    # Reading the variables flushes any queued fused steps first — a direct
+    # `model.params` read (weight-norm logging, accelerator.gather) must
+    # never see values that are K queued updates stale. Internal code that
+    # runs *during* a flush touches `_params` directly (the queue is popped
+    # at flush entry, so the re-entrant flush callback is a no-op, but
+    # skipping the property keeps the hot path cheap).
+    @property
+    def params(self):
+        self._flush_queues()
+        return self._params
+
+    @params.setter
+    def params(self, value):
+        self._params = value
+
+    @property
+    def model_state(self):
+        self._flush_queues()
+        return self._model_state
+
+    @model_state.setter
+    def model_state(self, value):
+        self._model_state = value
+
     def _ensure_init(self, x):
-        if self.params is not None:
+        if self._params is not None:  # backing field: must not flush the queue
             return
         # Pretrained fine-tune hook: a module carrying pre-loaded variables
         # (tpuddp.models.torch_import.load_pretrained_alexnet) starts from
@@ -244,7 +268,7 @@ class PreparedModel:
             self._fwd[key] = jax.jit(fwd)
         rng = self.accelerator._next_key() if train else jax.random.key(0)
         xr = replicate(self.accelerator.mesh, jnp.asarray(x))
-        return self._fwd[key](self.params, self.model_state, xr, rng)
+        return self._fwd[key](self._params, self._model_state, xr, rng)
 
     def _get_grad_step(self, criterion):
         if self._grad_step is None or self._grad_step[0] is not criterion:
@@ -307,9 +331,9 @@ class PreparedModel:
         xb, yb, wb = self._shard_xyw(x, y, w)
         fn = self._get_grad_step(criterion)
         loss, grads, new_mstate = fn(
-            self.params, self.model_state, self._bwd_key, step_idx, xb, yb, wb
+            self._params, self._model_state, self._bwd_key, step_idx, xb, yb, wb
         )
-        self.model_state = new_mstate
+        self._model_state = new_mstate
         self._pending_grads = grads
         self._pending = None
         lazy_loss._value = loss
@@ -448,10 +472,10 @@ class PreparedOptimizer:
         model = self.model
         fn = model._get_fused_step(criterion, self.optimizer)
         loss, new_params, new_mstate, new_opt = fn(
-            model.params, model.model_state, self.opt_state,
+            model._params, model._model_state, self.opt_state,
             model._bwd_key, step_idx, xb, yb, wb,
         )
-        model.params, model.model_state = new_params, new_mstate
+        model._params, model._model_state = new_params, new_mstate
         self.opt_state = new_opt
         lazy_loss._value = loss
 
@@ -478,10 +502,10 @@ class PreparedOptimizer:
         ys = tuple(e[1] for e in queue)
         ws = tuple(e[2] for e in queue)
         new_params, new_mstate, new_opt, losses = fn(
-            model.params, model.model_state, self.opt_state,
+            model._params, model._model_state, self.opt_state,
             model._bwd_key, idxs, xs, ys, ws,
         )
-        model.params, model.model_state = new_params, new_mstate
+        model._params, model._model_state = new_params, new_mstate
         self.opt_state = new_opt
         for i, entry in enumerate(queue):
             lazy_loss = entry[5]

@@ -31,7 +31,7 @@ TRAINING_DEFAULTS = {
     "num_epochs": 20,  # :166
     "checkpoint_epoch": 5,  # :167
     "image_size": 224,  # data_and_toy_model.py:14
-    "flip": True,  # RandomHorizontalFlip in the train augment (:15)
+    "flip": None,  # RandomHorizontalFlip (:15); None -> on except for digits
     "seed": None,  # None -> fresh per run, like torch initial_seed
     "mode": "shard_map",
     "sync_bn": False,

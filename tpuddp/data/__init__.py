@@ -41,6 +41,16 @@ def load_datasets_for(training: Dict[str, Any], synthetic_fallback: bool = True)
     )
 
 
+def flip_for(training: Dict[str, Any]) -> bool:
+    """Horizontal-flip augmentation setting: explicit ``training.flip`` wins;
+    the default follows the dataset (CIFAR photos are flip-invariant,
+    data_and_toy_model.py:15; handwritten digits are not)."""
+    f = training.get("flip")
+    if f is not None:
+        return bool(f)
+    return str(training.get("dataset") or "cifar10") != "digits"
+
+
 def norm_stats_for(training: Dict[str, Any]) -> Tuple[Sequence[float], Sequence[float]]:
     """Per-dataset normalization (mean, std) for the device-side transforms
     (the reference bakes CIFAR constants into its torchvision pipeline,
@@ -62,4 +72,5 @@ __all__ = [
     "SyntheticClassification",
     "load_datasets_for",
     "norm_stats_for",
+    "flip_for",
 ]

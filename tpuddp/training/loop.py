@@ -53,6 +53,41 @@ def resolve_scan_steps(scan_steps, n_batches: int) -> int:
     return k
 
 
+def _fused_pass(
+    ddp, state, loader, scan_k: int, step_one, step_many, probe_cb=None
+):
+    """One pass over ``loader`` with K-fused dispatch + one-chunk upload
+    lookahead (device_put is async, so staging chunk N+1 before dispatching N
+    overlaps host->HBM transfer with the previous dispatch's compute). Shared
+    by the train and eval passes; ``step_*(state, batch) -> (state, metrics)``.
+    Returns ``(state, accumulated_metrics)``."""
+    acc = None
+    chunk = []
+    staged = None
+    for batch_idx, host_batch in enumerate(loader):
+        if probe_cb is not None:
+            probe_cb(batch_idx, host_batch)
+        if scan_k <= 1:
+            state, metrics = step_one(state, ddp.shard(host_batch))
+            acc = accumulate_metrics(acc, metrics)
+            continue
+        chunk.append(host_batch)
+        if len(chunk) == scan_k:
+            next_staged = ddp.shard_stacked(stack_batches(chunk))
+            chunk = []
+            if staged is not None:
+                state, metrics = step_many(state, staged)
+                acc = accumulate_metrics(acc, metrics)
+            staged = next_staged
+    if staged is not None:
+        state, metrics = step_many(state, staged)
+        acc = accumulate_metrics(acc, metrics)
+    for host_batch in chunk:  # remainder: single steps, same semantics
+        state, metrics = step_one(state, ddp.shard(host_batch))
+        acc = accumulate_metrics(acc, metrics)
+    return state, acc
+
+
 def run_training_loop(
     ddp,
     state,
@@ -109,59 +144,25 @@ def run_training_loop(
 
         # ---- train pass (hot loop: one jitted step per batch, or per
         # `scan_steps` batches fused into a single lax.scan dispatch) ----
-        train_acc = None
-        chunk = []
-        staged = None  # one-chunk upload lookahead: device_put is async, so
-        # staging chunk N+1 before dispatching N overlaps host->HBM transfer
-        # with the previous dispatch's compute
-        for batch_idx, host_batch in enumerate(train_loader):
+        def train_probe(batch_idx, host_batch):
             if data_probe_every and batch_idx % data_probe_every == 0:
                 probe = getattr(train_loader, "probe_fingerprint", None)
                 if probe is not None:
                     log(f"TRAIN: Batch {batch_idx}, Data {probe(host_batch[0])}")
-            if scan_steps <= 1:
-                state, metrics = ddp.train_step(state, ddp.shard(host_batch))
-                train_acc = accumulate_metrics(train_acc, metrics)
-                continue
-            chunk.append(host_batch)
-            if len(chunk) == scan_steps:
-                next_staged = ddp.shard_stacked(stack_batches(chunk))
-                chunk = []
-                if staged is not None:
-                    state, metrics = ddp.train_step_many(state, staged)
-                    train_acc = accumulate_metrics(train_acc, metrics)
-                staged = next_staged
-        if staged is not None:
-            state, metrics = ddp.train_step_many(state, staged)
-            train_acc = accumulate_metrics(train_acc, metrics)
-        for host_batch in chunk:  # remainder: single steps, same semantics
-            state, metrics = ddp.train_step(state, ddp.shard(host_batch))
-            train_acc = accumulate_metrics(train_acc, metrics)
 
-        # ---- eval pass (same K-fused dispatch + upload lookahead as train;
-        # without it the eval epoch is per-batch dispatch-bound) ----
-        eval_acc = None
-        chunk = []
-        staged = None
-        for host_batch in test_loader:
-            if eval_scan_steps <= 1:
-                metrics = ddp.eval_step(state, ddp.shard(host_batch))
-                eval_acc = accumulate_metrics(eval_acc, metrics)
-                continue
-            chunk.append(host_batch)
-            if len(chunk) == eval_scan_steps:
-                next_staged = ddp.shard_stacked(stack_batches(chunk))
-                chunk = []
-                if staged is not None:
-                    metrics = ddp.eval_step_many(state, staged)
-                    eval_acc = accumulate_metrics(eval_acc, metrics)
-                staged = next_staged
-        if staged is not None:
-            metrics = ddp.eval_step_many(state, staged)
-            eval_acc = accumulate_metrics(eval_acc, metrics)
-        for host_batch in chunk:  # remainder: single steps, same semantics
-            metrics = ddp.eval_step(state, ddp.shard(host_batch))
-            eval_acc = accumulate_metrics(eval_acc, metrics)
+        state, train_acc = _fused_pass(
+            ddp, state, train_loader, scan_steps,
+            ddp.train_step, ddp.train_step_many, probe_cb=train_probe,
+        )
+
+        # ---- eval pass (same K-fused dispatch + upload lookahead; without
+        # it the eval epoch is per-batch dispatch-bound). State threads
+        # through untouched. ----
+        _, eval_acc = _fused_pass(
+            ddp, state, test_loader, eval_scan_steps,
+            lambda s, b: (s, ddp.eval_step(s, b)),
+            lambda s, b: (s, ddp.eval_step_many(s, b)),
+        )
 
         if train_acc is None:
             raise RuntimeError(

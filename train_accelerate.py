@@ -23,7 +23,7 @@ import numpy as np
 from tpuddp import config as cfg_lib
 from tpuddp import nn, optim
 from tpuddp.accelerate import Accelerator
-from tpuddp.data import DataLoader, load_datasets_for, norm_stats_for
+from tpuddp.data import DataLoader, flip_for, load_datasets_for, norm_stats_for
 from tpuddp.data.transforms import make_eval_transform, make_train_augment
 
 logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -213,7 +213,7 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
     augment = jax.jit(
         make_train_augment(
             size=training.get("image_size"),
-            flip=bool(training.get("flip", True)),
+            flip=flip_for(training),
             mean=mean,
             std=std,
         )

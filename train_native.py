@@ -25,7 +25,13 @@ import jax.numpy as jnp
 
 from tpuddp import config as cfg_lib
 from tpuddp import nn, optim, seeding
-from tpuddp.data import PrefetchLoader, ShardedDataLoader, load_datasets_for, norm_stats_for
+from tpuddp.data import (
+    PrefetchLoader,
+    ShardedDataLoader,
+    flip_for,
+    load_datasets_for,
+    norm_stats_for,
+)
 from tpuddp.data.transforms import make_eval_transform, make_train_augment
 from tpuddp.models import load_model
 from tpuddp.parallel.ddp import DistributedDataParallel
@@ -70,7 +76,7 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
     size = training.get("image_size")
     mean, std = norm_stats_for(training)
     augment = make_train_augment(
-        size=size, flip=bool(training.get("flip", True)), mean=mean, std=std
+        size=size, flip=flip_for(training), mean=mean, std=std
     )
     eval_transform = make_eval_transform(size=size, mean=mean, std=std)
 
