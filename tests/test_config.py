@@ -137,3 +137,25 @@ def test_num_classes_explicit_overrides_dataset():
 def test_num_classes_unknown_dataset_requires_explicit():
     with pytest.raises(ValueError, match="num_classes"):
         cfg.num_classes_from({"dataset": "imagenet21k"})
+
+
+def test_example_configs_parse_and_validate(monkeypatch):
+    """Every YAML under configs/ must parse, produce a valid training config,
+    and resolve rendezvous/world-size without error."""
+    import glob
+
+    for var in ("TPUDDP_COORDINATOR", "TPUDDP_NUM_PROCESSES", "TPUDDP_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sorted(glob.glob(os.path.join(repo, "configs", "*.yaml")))
+    assert len(paths) >= 4
+    for p in paths:
+        settings = cfg.load_settings(p)
+        training = cfg.training_config(settings)
+        assert cfg.num_classes_from(training) == 10
+        cfg.world_size_from(settings)
+        cfg.device_from(settings)
+        if "rendezvous" in settings.get("local", {}):
+            monkeypatch.setenv("TPUDDP_PROCESS_ID", "0")
+            rdv = cfg.rendezvous_from(settings)
+            assert rdv["coordinator_address"]

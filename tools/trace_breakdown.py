@@ -47,21 +47,38 @@ def load_ops(trace_dir: str):
     ]
 
 
+import re
+
+_SHAPE_TOKEN = re.compile(r"\b(?:f32|bf16|f16)\[[\d,]+\]")
+
+
+def _looks_like_optimizer_update(shape_with_layout: str) -> bool:
+    """An op whose output tuple repeats the same weight shape >= 3 times is a
+    fused stateful-optimizer update — Adam's (new_param, m, v) riding on the
+    weight-grad dot. (A 2-slot optimizer like SGD+momentum would need >= 2,
+    but 2 identical outputs also matches fwd activation+stash pairs, so this
+    heuristic stays at 3; ops from tpuddp/optim sources are caught by name.)"""
+    if not shape_with_layout.startswith("("):
+        return False
+    tokens = _SHAPE_TOKEN.findall(shape_with_layout)
+    counts = collections.Counter(t.split("{")[0] for t in tokens)
+    return any(c >= 3 for c in counts.values())
+
+
 def categorize(e) -> str:
     a = e.get("args") or {}
     src, tf_op = a.get("source", ""), a.get("tf_op", "")
-    swl = a.get("shape_with_layout", "")
     if "transforms.py" in src or "_resize" in tf_op:
         return "augment/resize"
-    # an op whose output tuple repeats a large weight shape is the fused
-    # optimizer update (param, m, v) riding on the weight-grad dot
-    if "optim" in src or any(
-        swl.count(s) >= 2
-        for s in ("f32[9216,4096]", "f32[4096,4096]", "f32[4096,10]")
+    if "optim" in src or _looks_like_optimizer_update(
+        a.get("shape_with_layout", "")
     ):
-        return "optimizer+weight traffic"
+        # these fused ops contain BOTH the weight-grad dot/conv and the
+        # optimizer state update; their byte/flop ratio tells which side
+        # dominates (see BASELINE.md's analysis)
+        return "weight-grad + optimizer (fused)"
     if "conv" in tf_op or "dot_general" in tf_op:
-        return "matmul/conv compute"
+        return "fwd/input-grad conv+matmul"
     if "copy" in e["name"] or "slice" in e["name"]:
         return "copies/slices"
     return "other elementwise"
