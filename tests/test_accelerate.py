@@ -307,3 +307,32 @@ def test_params_read_flushes_fuse_queue(mesh):
         )
     )
     assert moved
+
+
+def test_failed_flush_marks_queued_losses_dropped(mesh, monkeypatch):
+    """If the fused-scan dispatch fails, the queued updates are lost — later
+    reads of the queued losses must raise, not silently recompute a forward
+    against the un-updated params."""
+    acc = Accelerator(mesh=mesh, seed=3, fuse_steps=2)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(0.5))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+
+    model(x)
+    loss1 = criterion(model(x), y)
+    acc.backward(loss1)
+    opt.step()  # queued (1 of 2)
+    monkeypatch.setattr(
+        opt, "_dispatch_flush",
+        lambda q: (_ for _ in ()).throw(RuntimeError("simulated dispatch failure")),
+    )
+    loss2 = criterion(model(x), y)
+    acc.backward(loss2)
+    with pytest.raises(RuntimeError, match="simulated"):
+        opt.step()  # 2nd entry triggers the (failing) flush
+    assert opt._queue == []
+    for l in (loss1, loss2):
+        assert l._queued_on is None
+        with pytest.raises(RuntimeError, match="dropped"):
+            l.item()

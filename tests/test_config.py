@@ -159,3 +159,9 @@ def test_example_configs_parse_and_validate(monkeypatch):
             monkeypatch.setenv("TPUDDP_PROCESS_ID", "0")
             rdv = cfg.rendezvous_from(settings)
             assert rdv["coordinator_address"]
+
+
+def test_rendezvous_multiprocess_requires_coordinator():
+    with pytest.raises(ValueError, match="coordinator_address"):
+        cfg.rendezvous_from({"local": {"rendezvous": {"num_processes": 2,
+                                                      "process_id": 0}}})

@@ -43,3 +43,27 @@ def test_reexec_guard_detects_failed_expansion(monkeypatch):
     monkeypatch.setenv("TPUDDP_SPAWNED", "1")
     with pytest.raises(RuntimeError, match="re-exec"):
         maybe_reexec_for_world(4096, "cpu")
+
+
+def test_multihost_reexec_flag_match_is_exact(monkeypatch):
+    """A pre-existing --xla_force_host_platform_device_count=16 must NOT
+    satisfy a desired =1 via substring containment; the launcher replaces a
+    wrong pre-set count instead of skipping the re-exec."""
+    from tpuddp.parallel import spawn
+
+    captured = {}
+
+    def fake_exec(exe, argv, env):
+        captured["flags"] = env["XLA_FLAGS"]
+
+    monkeypatch.setattr(spawn.os, "execvpe", fake_exec)
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=16")
+    monkeypatch.delenv(spawn._REEXEC_GUARD, raising=False)
+    spawn.maybe_reexec_for_multihost_world(2, 2, backend="cpu")
+    assert captured["flags"] == "--xla_force_host_platform_device_count=1"
+
+    # exact match -> no re-exec
+    captured.clear()
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    spawn.maybe_reexec_for_multihost_world(2, 2, backend="cpu")
+    assert captured == {}

@@ -486,6 +486,21 @@ class PreparedOptimizer:
         queue, self._queue = self._queue, []
         if not queue:
             return
+        try:
+            self._dispatch_flush(queue)
+        except BaseException:
+            # The dispatch failed (compile OOM, runtime disconnect): the
+            # queued updates are lost and donated buffers may be gone. Make
+            # every still-unresolved loss read fail loudly rather than
+            # silently recompute a forward against un-updated params.
+            for entry in queue:
+                lazy_loss = entry[5]
+                lazy_loss._queued_on = None
+                if lazy_loss._value is None and lazy_loss._value_src is None:
+                    lazy_loss._dropped = True
+            raise
+
+    def _dispatch_flush(self, queue):
         model = self.model
         if len(queue) != getattr(model.accelerator, "fuse_steps", 1):
             # partial flush (epoch remainder / early read): reuse the

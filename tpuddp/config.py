@@ -145,7 +145,17 @@ def rendezvous_from(settings: Dict[str, Any]) -> Dict[str, Any]:
             f"unknown local.rendezvous keys {sorted(unknown)}; expected "
             "coordinator_address, num_processes, process_id"
         )
-    if out.get("coordinator_address") and out.get("num_processes", 1) > 1:
+    if out.get("num_processes", 1) > 1:
+        if not out.get("coordinator_address"):
+            # fail here with a clear message — without it the multi-process
+            # request skips the dev re-exec (which gates on the coordinator)
+            # yet still reaches jax.distributed.initialize(None, ...), which
+            # dies late with an obscure runtime error
+            raise ValueError(
+                "local.rendezvous with num_processes > 1 needs a "
+                "coordinator_address (host:port of process 0; set "
+                "TPUDDP_COORDINATOR, or the YAML key)"
+            )
         if "process_id" not in out:
             raise ValueError(
                 "local.rendezvous with num_processes > 1 needs a process_id "

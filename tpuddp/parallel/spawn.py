@@ -74,17 +74,29 @@ def maybe_reexec_for_multihost_world(
     prefer = backend or os.environ.get(_backend._BACKEND_ENV)
     if prefer != "cpu" or not world_size or num_processes <= 1:
         return
+    import re
+
     local = max(1, world_size // num_processes)
     flag = f"--xla_force_host_platform_device_count={local}"
     flags = os.environ.get("XLA_FLAGS", "")
-    if flag in flags:
+    # exact-value match only: substring containment would let a pre-existing
+    # =16 satisfy a desired =1 (shared digit prefix) and skip the re-exec
+    existing = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if existing and int(existing.group(1)) == local:
         return
     if os.environ.get(_REEXEC_GUARD):
         raise RuntimeError(
             f"re-exec with {flag} did not stick; XLA_FLAGS={flags!r}"
         )
     env = dict(os.environ)
-    env["XLA_FLAGS"] = f"{flags} {flag}".strip()
+    if existing:
+        # a different pre-set count (e.g. a dev shell's =8) would build the
+        # wrong local world; replace it with this launch's value
+        env["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags
+        )
+    else:
+        env["XLA_FLAGS"] = f"{flags} {flag}".strip()
     env[_REEXEC_GUARD] = "1"
     logger.info(
         "re-exec for %d-local-device CPU world (%d processes)", local, num_processes
