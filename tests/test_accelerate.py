@@ -334,5 +334,51 @@ def test_failed_flush_marks_queued_losses_dropped(mesh, monkeypatch):
     assert opt._queue == []
     for l in (loss1, loss2):
         assert l._queued_on is None
-        with pytest.raises(RuntimeError, match="dropped"):
+        with pytest.raises(RuntimeError, match="dispatch failed"):
             l.item()
+    # compile-time failure: buffers were never donated, params stay readable
+    assert model.params is not None
+
+
+def test_load_model_restores_saved_weights(acc, tmp_path):
+    """Managed resume: save_model -> train further -> load_model returns the
+    model to the saved weights (the counterpart the native path has via
+    restore_latest)."""
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(0.5))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+    model(x)
+    acc.save_model(model, str(tmp_path))
+    saved = jax.tree_util.tree_map(np.asarray, model.params)
+
+    loss = criterion(model(x), y)
+    acc.backward(loss)
+    opt.step()  # move away from the saved weights
+
+    acc.load_model(model, str(tmp_path))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        model.params, saved,
+    )
+
+    fresh = acc.prepare(ToyMLP(hidden=(8,)))
+    with pytest.raises(RuntimeError, match="initialized"):
+        acc.load_model(fresh, str(tmp_path))
+
+
+def test_lost_state_sentinel_reads_raise(acc):
+    """If a fused dispatch failed after buffer donation, any read of the
+    model's variables must raise a clear error, not JAX's obscure
+    'Array has been deleted'."""
+    from tpuddp.accelerate import _LOST_TO_FAILED_FLUSH
+
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(0.1))
+    model(np.zeros((8, 4, 4, 3), np.float32))
+    model._params = model._model_state = _LOST_TO_FAILED_FLUSH
+    with pytest.raises(RuntimeError, match="checkpoint"):
+        _ = model.params
+    with pytest.raises(RuntimeError, match="checkpoint"):
+        model._forward_concrete(np.zeros((4, 4, 4, 3), np.float32))
+    with pytest.raises(RuntimeError, match="re-prepare"):
+        acc.load_model(model, "/nonexistent")

@@ -161,7 +161,18 @@ def test_example_configs_parse_and_validate(monkeypatch):
             assert rdv["coordinator_address"]
 
 
-def test_rendezvous_multiprocess_requires_coordinator():
+def test_rendezvous_multiprocess_requires_coordinator_on_cpu(monkeypatch):
+    for var in ("TPUDDP_COORDINATOR", "TPUDDP_NUM_PROCESSES", "TPUDDP_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    rdv = {"rendezvous": {"num_processes": 2, "process_id": 0}}
+    # CPU dev rung has no auto-discovery: coordinator required
     with pytest.raises(ValueError, match="coordinator_address"):
-        cfg.rendezvous_from({"local": {"rendezvous": {"num_processes": 2,
-                                                      "process_id": 0}}})
+        cfg.rendezvous_from({"local": dict(rdv, device="cpu")})
+    # TPU pods auto-discover peers: no coordinator needed
+    out = cfg.rendezvous_from({"local": dict(rdv, device="tpu")})
+    assert out == {"num_processes": 2, "process_id": 0}
+    # pod auto-discovery may omit process_id too
+    out = cfg.rendezvous_from(
+        {"local": {"device": "tpu", "rendezvous": {"num_processes": 2}}}
+    )
+    assert out == {"num_processes": 2}

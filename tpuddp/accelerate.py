@@ -84,6 +84,10 @@ class LazyLoss:
         self._value = None
         self._backward_requested = False
         self._dropped = False  # backward request superseded/cleared unexecuted
+        self._drop_reason = (
+            "a second accelerator.backward() or zero_grad() preceded "
+            "optimizer.step()"
+        )
         self._queued_on = None  # PreparedOptimizer holding this in a fuse queue
         self._value_src = None  # (losses_array, i) from a fused-scan flush
 
@@ -120,9 +124,7 @@ class LazyLoss:
             # different from the loss that was requested — refuse instead.
             raise RuntimeError(
                 "this loss's backward request was dropped before it executed "
-                "(a second accelerator.backward() or zero_grad() preceded "
-                "optimizer.step()); its value was never computed. Read the "
-                "loss before dropping it, or step() between backwards."
+                f"({self._drop_reason}); its value was never computed."
             )
         if self._value is None:
             # forward-only path (no backward requested, e.g. eval loops)
@@ -166,6 +168,17 @@ def sum_losses(losses):
     return total
 
 
+class _LostState:
+    """Sentinel for model variables whose device buffers were donated to a
+    fused dispatch that then failed — any read must fail loudly."""
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return "<model state lost to a failed fused dispatch>"
+
+
+_LOST_TO_FAILED_FLUSH = _LostState()
+
+
 class PreparedModel:
     """The managed model: owns params/buffers, a compiled sharded train
     grad-step, and compiled replicated inference forwards. Mode toggles
@@ -202,9 +215,19 @@ class PreparedModel:
     # runs *during* a flush touches `_params` directly (the queue is popped
     # at flush entry, so the re-entrant flush callback is a no-op, but
     # skipping the property keeps the hot path cheap).
+    def _check_not_lost(self):
+        if self._params is _LOST_TO_FAILED_FLUSH:
+            raise RuntimeError(
+                "the model's device buffers were donated to a fused-step "
+                "dispatch that failed mid-execution; the parameters no "
+                "longer exist. Restore from a checkpoint "
+                "(accelerator.load_model) before continuing."
+            )
+
     @property
     def params(self):
         self._flush_queues()
+        self._check_not_lost()
         return self._params
 
     @params.setter
@@ -214,6 +237,7 @@ class PreparedModel:
     @property
     def model_state(self):
         self._flush_queues()
+        self._check_not_lost()
         return self._model_state
 
     @model_state.setter
@@ -257,6 +281,7 @@ class PreparedModel:
         Unprepared eval loaders feed the FULL batch to every process — the
         reference's accelerate eval behavior (quirk Q3)."""
         self._flush_queues()  # queued updates must land before params are read
+        self._check_not_lost()
         train = self._training
         key = (np.shape(x), train)
         if key not in self._fwd:
@@ -327,6 +352,7 @@ class PreparedModel:
 
     def _materialize_grads(self):
         self._flush_queues()  # grads must differentiate the CURRENT params
+        self._check_not_lost()
         x, y, w, criterion, step_idx, lazy_loss = self._pending
         xb, yb, wb = self._shard_xyw(x, y, w)
         fn = self._get_grad_step(criterion)
@@ -431,6 +457,7 @@ class PreparedOptimizer:
 
     def step(self):
         model = self.model
+        model._check_not_lost()
         if model._pending_grads is None:
             raise RuntimeError(
                 "optimizer.step() called without a preceding accelerator.backward(loss)"
@@ -493,11 +520,26 @@ class PreparedOptimizer:
             # queued updates are lost and donated buffers may be gone. Make
             # every still-unresolved loss read fail loudly rather than
             # silently recompute a forward against un-updated params.
+            model = self.model
             for entry in queue:
                 lazy_loss = entry[5]
                 lazy_loss._queued_on = None
                 if lazy_loss._value is None and lazy_loss._value_src is None:
                     lazy_loss._dropped = True
+                    lazy_loss._drop_reason = (
+                        "its fused-step dispatch failed (see the original "
+                        "exception)"
+                    )
+            # Donation only happens if execution started; a trace/compile
+            # failure leaves the buffers valid. If they WERE donated, poison
+            # the model so later params reads raise a clear error instead of
+            # JAX's obscure 'Array has been deleted'.
+            leaves = jax.tree_util.tree_leaves(
+                (model._params, model._model_state, self.opt_state)
+            )
+            if any(getattr(l, "is_deleted", lambda: False)() for l in leaves):
+                model._params = model._model_state = _LOST_TO_FAILED_FLUSH
+                self.opt_state = None
             raise
 
     def _dispatch_flush(self, queue):
@@ -657,6 +699,33 @@ class Accelerator:
                 {"params": model.params, "model_state": model.model_state},
             )
         col.barrier("tpuddp_accelerate_save")
+
+    def load_model(self, model: PreparedModel, save_dir: str):
+        """Restore the weights written by :meth:`save_model` into a prepared
+        model (the managed resume path; the reference only documents loading,
+        README.md:51-52). The model must have been initialized (one forward
+        or a prior training step) so the checkpoint has a structure to load
+        into."""
+        model._flush_queues()
+        if model._params is _LOST_TO_FAILED_FLUSH:
+            raise RuntimeError(
+                "this model's buffers were lost to a failed fused dispatch; "
+                "re-prepare it (accelerator.prepare) and run one forward, "
+                "then load_model"
+            )
+        if model._params is None:
+            raise RuntimeError(
+                "load_model needs an initialized model: run one forward "
+                "(model(x)) first so the parameter structure exists"
+            )
+        restored = ckpt.load(
+            os.path.join(save_dir, "model.npz"),
+            {"params": model._params, "model_state": model._model_state},
+        )
+        model._params, model._model_state = replicate(
+            self.mesh, (restored["params"], restored["model_state"])
+        )
+        return model
 
     def gather(self, x):
         """Concatenate a data-sharded array's shards onto every host."""

@@ -146,17 +146,19 @@ def rendezvous_from(settings: Dict[str, Any]) -> Dict[str, Any]:
             "coordinator_address, num_processes, process_id"
         )
     if out.get("num_processes", 1) > 1:
-        if not out.get("coordinator_address"):
-            # fail here with a clear message — without it the multi-process
-            # request skips the dev re-exec (which gates on the coordinator)
-            # yet still reaches jax.distributed.initialize(None, ...), which
-            # dies late with an obscure runtime error
+        if not out.get("coordinator_address") and device_from(settings) == "cpu":
+            # On the CPU dev rung there is no auto-discovery: without a
+            # coordinator the request skips the dev re-exec (which gates on
+            # it) yet still reaches jax.distributed.initialize(None, ...),
+            # which dies late with an obscure runtime error. On TPU pods a
+            # missing coordinator/process_id is VALID — initialize()
+            # auto-discovers peers from the pod environment (backend.setup).
             raise ValueError(
-                "local.rendezvous with num_processes > 1 needs a "
-                "coordinator_address (host:port of process 0; set "
+                "local.rendezvous with num_processes > 1 on the CPU backend "
+                "needs a coordinator_address (host:port of process 0; set "
                 "TPUDDP_COORDINATOR, or the YAML key)"
             )
-        if "process_id" not in out:
+        if out.get("coordinator_address") and "process_id" not in out:
             raise ValueError(
                 "local.rendezvous with num_processes > 1 needs a process_id "
                 "(set TPUDDP_PROCESS_ID per host, or the YAML key)"

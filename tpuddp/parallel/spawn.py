@@ -35,6 +35,26 @@ logger = logging.getLogger("tpuddp")
 _REEXEC_GUARD = "TPUDDP_SPAWNED"
 
 
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def _flags_with_device_count(flags: str, n: int):
+    """Return ``(new_flags, already_exact)`` with the virtual-device-count
+    flag set to exactly ``n``. Matching must be by exact value and a wrong
+    pre-set count must be REPLACED, not appended alongside (two contradictory
+    values would leave the winner to ABSL parse order) — and substring
+    containment is not a match (``=16`` must not satisfy ``=1``)."""
+    import re
+
+    flag = f"{_COUNT_FLAG}={n}"
+    existing = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+    if existing:
+        if int(existing.group(1)) == n:
+            return flags, True
+        return re.sub(rf"{_COUNT_FLAG}=\d+", flag, flags), False
+    return f"{flags} {flag}".strip(), False
+
+
 def maybe_reexec_for_world(world_size: int, backend: Optional[str] = None) -> None:
     """Dev-mode launcher: ensure an N-device CPU world exists, re-execing the
     current process with XLA_FLAGS if needed. No-op when enough devices (of
@@ -51,9 +71,8 @@ def maybe_reexec_for_world(world_size: int, backend: Optional[str] = None) -> No
             "initialized before the flag took effect"
         )
     env = dict(os.environ)
-    flags = env.get("XLA_FLAGS", "")
-    env["XLA_FLAGS"] = (
-        f"{flags} --xla_force_host_platform_device_count={world_size}".strip()
+    env["XLA_FLAGS"], _ = _flags_with_device_count(
+        env.get("XLA_FLAGS", ""), world_size
     )
     env[_REEXEC_GUARD] = "1"
     env.setdefault("TPUDDP_BACKEND", "cpu")
@@ -74,29 +93,18 @@ def maybe_reexec_for_multihost_world(
     prefer = backend or os.environ.get(_backend._BACKEND_ENV)
     if prefer != "cpu" or not world_size or num_processes <= 1:
         return
-    import re
-
     local = max(1, world_size // num_processes)
-    flag = f"--xla_force_host_platform_device_count={local}"
     flags = os.environ.get("XLA_FLAGS", "")
-    # exact-value match only: substring containment would let a pre-existing
-    # =16 satisfy a desired =1 (shared digit prefix) and skip the re-exec
-    existing = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
-    if existing and int(existing.group(1)) == local:
+    new_flags, already_exact = _flags_with_device_count(flags, local)
+    if already_exact:
         return
     if os.environ.get(_REEXEC_GUARD):
         raise RuntimeError(
-            f"re-exec with {flag} did not stick; XLA_FLAGS={flags!r}"
+            f"re-exec with {_COUNT_FLAG}={local} did not stick; "
+            f"XLA_FLAGS={flags!r}"
         )
     env = dict(os.environ)
-    if existing:
-        # a different pre-set count (e.g. a dev shell's =8) would build the
-        # wrong local world; replace it with this launch's value
-        env["XLA_FLAGS"] = re.sub(
-            r"--xla_force_host_platform_device_count=\d+", flag, flags
-        )
-    else:
-        env["XLA_FLAGS"] = f"{flags} {flag}".strip()
+    env["XLA_FLAGS"] = new_flags
     env[_REEXEC_GUARD] = "1"
     logger.info(
         "re-exec for %d-local-device CPU world (%d processes)", local, num_processes
