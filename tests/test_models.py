@@ -107,3 +107,34 @@ def test_alexnet_dropout_only_in_train():
     t1, _ = model.apply(params, state, x, Context(train=True, rng=jax.random.key(1)))
     t2, _ = model.apply(params, state, x, Context(train=True, rng=jax.random.key(2)))
     assert not np.allclose(np.asarray(t1), np.asarray(t2))  # stochastic train
+
+
+def test_resnet34_shapes_and_param_count():
+    """ResNet-34: [3,4,6,3] BasicBlocks; torchvision resnet34 has 21.28M
+    params at 1000 classes — ours at 10 classes should land at the same
+    count minus the head difference."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpuddp.models import ResNet34
+    from tpuddp.nn.core import Context
+
+    model = ResNet34(num_classes=10, small_input=True)
+    params, state = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    n_params = sum(
+        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params)
+    )
+    # torchvision resnet34: 21,797,672 at 1000 classes; minus its head
+    # (512*1000+1000), minus the small-input stem delta (7x7x3x64 ->
+    # 3x3x3x64 = -7,680), plus our 10-class head (512*10+10)
+    assert n_params == 21797672 - 513000 - 7680 + 5130, n_params
+    y, _ = model.apply(params, state, jnp.zeros((2, 32, 32, 3)), Context(train=False))
+    assert y.shape == (2, 10)
+
+
+def test_resnet34_registry_and_sync_bn():
+    from tpuddp.models import load_model
+    from tpuddp.nn.norm import has_divergent_buffers
+
+    m = load_model("resnet34_small", 10, sync_bn=True)
+    assert not has_divergent_buffers(m)  # every BN is synced

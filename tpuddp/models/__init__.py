@@ -5,7 +5,7 @@ and a ResNet-18 for the multi-host BASELINE config."""
 
 from tpuddp.models.toy import ToyCNN, ToyMLP  # noqa: F401
 from tpuddp.models.alexnet import AlexNet  # noqa: F401
-from tpuddp.models.resnet import ResNet18  # noqa: F401
+from tpuddp.models.resnet import ResNet18, ResNet34  # noqa: F401
 
 from functools import partial as _partial
 
@@ -14,8 +14,10 @@ _REGISTRY = {
     "toy_cnn": ToyCNN,
     "alexnet": AlexNet,
     "resnet18": ResNet18,
+    "resnet34": ResNet34,
     # CIFAR-style stem (3x3 conv, no maxpool) for small native resolutions
     "resnet18_small": _partial(ResNet18, small_input=True),
+    "resnet34_small": _partial(ResNet34, small_input=True),
 }
 
 
@@ -28,4 +30,4 @@ def load_model(name: str = "alexnet", num_classes: int = 10, **kwargs):
     return cls(num_classes=num_classes, **kwargs)
 
 
-__all__ = ["ToyMLP", "ToyCNN", "AlexNet", "ResNet18", "load_model"]
+__all__ = ["ToyMLP", "ToyCNN", "AlexNet", "ResNet18", "ResNet34", "load_model"]

@@ -1,6 +1,7 @@
-"""ResNet-18 (NHWC) — for the multi-host CIFAR BASELINE config
-(BASELINE.json configs[4]). BatchNorm layers honor convert_sync_batchnorm /
-``sync_bn=True`` so cross-replica statistic sync works under DP."""
+"""ResNets (NHWC) — ResNet-18 for the multi-host CIFAR BASELINE config
+(BASELINE.json configs[4]) and ResNet-34 (same BasicBlock, deeper stages).
+BatchNorm layers honor convert_sync_batchnorm / ``sync_bn=True`` so
+cross-replica statistic sync works under DP."""
 
 from __future__ import annotations
 
@@ -61,13 +62,16 @@ class GlobalAvgPool(Module):
         return x.mean(axis=(1, 2)), state
 
 
-def ResNet18(
-    num_classes: int = 10, sync_bn: bool = False, small_input: bool = False
+def _resnet(
+    depths,
+    num_classes: int,
+    sync_bn: bool,
+    small_input: bool,
 ) -> nn.Sequential:
-    """Standard ResNet-18: stem + [2,2,2,2] BasicBlocks at widths
-    [64,128,256,512] + global-avg-pool head. ``small_input=True`` uses the
-    CIFAR stem (3x3/1 conv, no maxpool) for native 32x32 training — the
-    TPU-friendly alternative to the reference's resize-everything-to-224."""
+    """stem + BasicBlock stages at widths [64,128,256,512] + GAP head.
+    ``small_input=True`` uses the CIFAR stem (3x3/1 conv, no maxpool) for
+    native 32x32 training — the TPU-friendly alternative to the reference's
+    resize-everything-to-224."""
     if small_input:
         stem = [
             nn.Conv2d(64, 3, strides=1, padding=1, use_bias=False),
@@ -82,8 +86,27 @@ def ResNet18(
             nn.MaxPool2d(3, strides=2, padding=1),
         ]
     blocks = []
-    for width, stride in [(64, 1), (128, 2), (256, 2), (512, 2)]:
+    for n_blocks, (width, stride) in zip(
+        depths, [(64, 1), (128, 2), (256, 2), (512, 2)]
+    ):
         blocks.append(BasicBlock(width, stride=stride, sync_bn=sync_bn))
-        blocks.append(BasicBlock(width, stride=1, sync_bn=sync_bn))
+        blocks.extend(
+            BasicBlock(width, stride=1, sync_bn=sync_bn)
+            for _ in range(n_blocks - 1)
+        )
     head = [GlobalAvgPool(), nn.Linear(num_classes)]
     return nn.Sequential(*stem, *blocks, *head)
+
+
+def ResNet18(
+    num_classes: int = 10, sync_bn: bool = False, small_input: bool = False
+) -> nn.Sequential:
+    """Standard ResNet-18: [2,2,2,2] BasicBlocks."""
+    return _resnet((2, 2, 2, 2), num_classes, sync_bn, small_input)
+
+
+def ResNet34(
+    num_classes: int = 10, sync_bn: bool = False, small_input: bool = False
+) -> nn.Sequential:
+    """Standard ResNet-34: [3,4,6,3] BasicBlocks."""
+    return _resnet((3, 4, 6, 3), num_classes, sync_bn, small_input)
