@@ -382,3 +382,25 @@ def test_lost_state_sentinel_reads_raise(acc):
         model._forward_concrete(np.zeros((4, 4, 4, 3), np.float32))
     with pytest.raises(RuntimeError, match="re-prepare"):
         acc.load_model(model, "/nonexistent")
+
+
+def test_managed_clip_grad_norm_bounds_update(mesh):
+    """Accelerator(clip_grad_norm=c): the global-batch gradient is clipped
+    before the update (with SGD lr=1 the param delta norm equals c)."""
+    acc = Accelerator(mesh=mesh, seed=4, clip_grad_norm=0.05)
+    model, opt = acc.prepare(ToyMLP(hidden=(16,)), optim.SGD(1.0))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(16, 8, 8, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 16)
+    model(x)
+    p0 = jax.tree_util.tree_map(np.asarray, model.params)
+    loss = criterion(model(x), y)
+    acc.backward(loss)
+    opt.step()
+    delta = jax.tree_util.tree_map(
+        lambda a, b: np.asarray(a) - b, model.params, p0
+    )
+    norm = float(
+        np.sqrt(sum(np.sum(d ** 2) for d in jax.tree_util.tree_leaves(delta)))
+    )
+    assert norm == pytest.approx(0.05, rel=1e-3)

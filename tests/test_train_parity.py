@@ -141,3 +141,40 @@ def test_masked_final_batch_metrics_are_exact(cpu_devices):
     # 50 samples over 4 replicas -> 13 each = 52 weighted samples (2 wrap-pads)
     assert final["n"] == 52.0
     assert 0 <= final["correct"] <= 52
+
+
+def test_clip_grad_norm_applies_after_aggregation(cpu_devices):
+    """training.clip_grad_norm clips the cross-replica-AVERAGED gradient
+    (the reference README's clip-before-aggregate caveat): the DP step with
+    a tight clip must match a single-device step whose full-batch grad is
+    clipped to the same norm."""
+    ds = SyntheticClassification(n=64, shape=(8, 8, 3), seed=9)
+    x, y = ds.get_batch(np.arange(64))
+    w = np.ones(64, np.float32)
+    clip = 0.05
+
+    def run(devices):
+        ddp = DistributedDataParallel(
+            ToyMLP(hidden=(16,)), optim.SGD(1.0), CrossEntropyLoss(),
+            mesh=make_mesh(devices), mode="shard_map", clip_grad_norm=clip,
+        )
+        state = ddp.init_state(jax.random.key(3), jnp.zeros((1, 8, 8, 3)))
+        state, _ = ddp.train_step(state, ddp.shard((x, y, w)))
+        return jax.tree_util.tree_map(np.asarray, state.params)
+
+    p_dp = run(cpu_devices)      # 8-way DP
+    p_single = run(cpu_devices[:1])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        p_dp, p_single,
+    )
+    # with SGD lr=1, the param delta norm == the clipped grad norm
+    fresh = DistributedDataParallel(
+        ToyMLP(hidden=(16,)), optim.SGD(1.0), CrossEntropyLoss(),
+        mesh=make_mesh(cpu_devices), mode="shard_map", clip_grad_norm=clip,
+    )
+    st0 = fresh.init_state(jax.random.key(3), jnp.zeros((1, 8, 8, 3)))
+    p0 = jax.tree_util.tree_map(np.asarray, st0.params)
+    delta = jax.tree_util.tree_map(lambda a, b: a - b, p_dp, p0)
+    norm = float(np.sqrt(sum(np.sum(d ** 2) for d in jax.tree_util.tree_leaves(delta))))
+    assert norm == pytest.approx(clip, rel=1e-3)

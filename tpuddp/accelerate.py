@@ -269,6 +269,13 @@ class PreparedModel:
         return LazyForward(self, x)
 
     # -- concrete executions --
+    def _maybe_clip(self, grads):
+        clip = getattr(self.accelerator, "clip_grad_norm", None)
+        if clip is None:
+            return grads
+        clipped, _ = optim_lib.clip_grad_norm_(grads, clip)
+        return clipped
+
     def _flush_queues(self):
         """Execute any queued fused steps so ``params``/``model_state`` are
         current before they are read (forward, save, gather)."""
@@ -312,7 +319,7 @@ class PreparedModel:
                 (loss, new_mstate), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
                 )(params)
-                return loss, grads, new_mstate
+                return loss, self._maybe_clip(grads), new_mstate
 
             self._grad_step = (criterion, jax.jit(grad_step))
         return self._grad_step[1]
@@ -382,6 +389,7 @@ class PreparedModel:
                 (loss, new_mstate), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
                 )(params)
+                grads = self._maybe_clip(grads)
                 new_params, new_opt = optimizer.update(grads, opt_state, params)
                 return loss, new_params, new_mstate, new_opt
 
@@ -422,6 +430,7 @@ class PreparedModel:
                     (loss, new_ms), grads = jax.value_and_grad(
                         loss_fn, has_aux=True
                     )(p)
+                    grads = self._maybe_clip(grads)
                     new_p, new_os = optimizer.update(grads, os_, p)
                     return (new_p, new_ms, new_os), loss
 
@@ -580,6 +589,7 @@ class Accelerator:
         seed: Optional[int] = None,
         fuse_steps: int = 1,
         num_chips: Optional[int] = None,
+        clip_grad_norm: Optional[float] = None,
     ):
         """``fuse_steps``: K > 1 batches per-step calls into one compiled
         lax.scan dispatch (the managed analog of the native scan fusion) —
@@ -595,6 +605,12 @@ class Accelerator:
         self._key = key
         self._models = []
         self.fuse_steps = max(1, int(fuse_steps))
+        # clip the GLOBAL-batch gradient (already cross-replica aggregated
+        # under jit) before the update — clip-after-aggregate semantics,
+        # same as the native path's clip_grad_norm
+        self.clip_grad_norm = (
+            float(clip_grad_norm) if clip_grad_norm is not None else None
+        )
 
     # -- topology (HF property-name parity) --
     @property

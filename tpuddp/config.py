@@ -36,6 +36,9 @@ TRAINING_DEFAULTS = {
     "mode": "shard_map",
     "sync_bn": False,
     "scan_steps": "auto",  # K train steps fused per dispatch (lax.scan); "auto" = up to 8
+    "clip_grad_norm": None,  # clip the cross-replica-AVERAGED grad (README's
+    # clip-before-aggregate caveat: clipping per-shard grads then averaging
+    # would differ; tpuddp clips after the pmean, identically on all replicas)
     "remat": False,  # jax.checkpoint: recompute activations in backward
     "prefetch": True,  # background-thread host batch prefetch
     "deferred_metrics": False,  # managed path: epoch-end (not per-batch) metric sync

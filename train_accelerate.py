@@ -190,7 +190,10 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
     if fuse in (None, "auto"):
         fuse = 8 if training.get("deferred_metrics") else 1
     accelerator = Accelerator(
-        seed=training.get("seed"), fuse_steps=int(fuse), num_chips=num_chips
+        seed=training.get("seed"),
+        fuse_steps=int(fuse),
+        num_chips=num_chips,
+        clip_grad_norm=training.get("clip_grad_norm"),
     )
 
     # Data + model (reference :118-122); placement is implicit on this path.

@@ -98,6 +98,7 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
     optimizer = optim.Adam(lr=training["learning_rate"])
 
     # The DDP wrap (reference :245): builds the shard_map'd pmean train step.
+    clip = training.get("clip_grad_norm")
     ddp = DistributedDataParallel(
         model,
         optimizer,
@@ -107,6 +108,7 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         augment=augment,
         eval_transform=eval_transform,
         remat=bool(training.get("remat", False)),
+        clip_grad_norm=float(clip) if clip is not None else None,
     )
     in_hw = size if size else train_ds.images.shape[1]
     state = ddp.init_state(
