@@ -404,3 +404,54 @@ def test_managed_clip_grad_norm_bounds_update(mesh):
         np.sqrt(sum(np.sum(d ** 2) for d in jax.tree_util.tree_leaves(delta)))
     )
     assert norm == pytest.approx(0.05, rel=1e-3)
+
+
+def test_gradient_accumulation_matches_big_batch(mesh):
+    """N micro-batches with gradient_accumulation_steps=N must produce the
+    same update as one step on the concatenated batch (mean-of-grads ==
+    grad-of-mean for equal shards), including the clip applied to the
+    AVERAGED gradient."""
+    ds = SyntheticClassification(n=32, shape=(8, 8, 3), seed=11)
+    x, y = ds.get_batch(np.arange(32))
+    w = np.ones(32, np.float32)
+    criterion = nn.CrossEntropyLoss()
+
+    # accumulated: 4 micro-batches of 8
+    acc_a = Accelerator(mesh=mesh, seed=5, gradient_accumulation_steps=4,
+                        clip_grad_norm=0.5)
+    m_a, o_a = acc_a.prepare(ToyMLP(hidden=(16,)), optim.SGD(1.0))
+    m_a(x[:8])
+    p0 = jax.tree_util.tree_map(np.asarray, m_a.params)
+    for i in range(4):
+        sl = slice(i * 8, (i + 1) * 8)
+        loss = criterion(m_a(x[sl]), y[sl], w[sl])
+        acc_a.backward(loss)
+        o_a.step()
+        o_a.zero_grad()  # HF pattern: safe every batch, must not clear accum
+
+    # big batch: one step on all 32, same init
+    acc_b = Accelerator(mesh=mesh, seed=6, clip_grad_norm=0.5)
+    m_b, o_b = acc_b.prepare(ToyMLP(hidden=(16,)), optim.SGD(1.0))
+    m_b(x)
+    m_b.params = jax.tree_util.tree_map(jnp.asarray, p0)
+    loss = criterion(m_b(x), y, w)
+    acc_b.backward(loss)
+    o_b.step()
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        m_a.params, m_b.params,
+    )
+    # mid-cycle state: a partial accumulation leaves params untouched
+    loss = criterion(m_a(x[:8]), y[:8], w[:8])
+    acc_a.backward(loss)
+    o_a.step()
+    assert o_a._accum_count == 1
+    assert o_a._accum_grads is not None
+
+
+def test_accumulation_and_fuse_steps_are_exclusive(mesh):
+    with pytest.raises(ValueError, match="exclusive"):
+        Accelerator(mesh=mesh, fuse_steps=4, gradient_accumulation_steps=2)

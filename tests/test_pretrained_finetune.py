@@ -104,7 +104,7 @@ def test_pretrained_finetune_beats_scratch(tmp_path, capsys, monkeypatch):
         datasets,
         dict(training, pretrained_path=str(ckpt)),
     )
-    assert "Loaded pretrained AlexNet weights" in out
+    assert "Loaded pretrained alexnet weights" in out
     assert finetune_loss < scratch_loss, (finetune_loss, scratch_loss)
 
 

@@ -89,3 +89,93 @@ def test_shape_mismatch_raises(models):
     bad["features.0.weight"] = torch.zeros(64, 3, 5, 5)
     with pytest.raises(ValueError, match="features.0"):
         convert_alexnet_state_dict(bad, params)
+
+
+class _TorchBasicBlock(tnn.Module):
+    def __init__(self, in_ch, out_ch, stride=1):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(in_ch, out_ch, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(out_ch)
+        self.conv2 = tnn.Conv2d(out_ch, out_ch, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(out_ch)
+        self.downsample = None
+        if stride != 1 or in_ch != out_ch:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(in_ch, out_ch, 1, stride, bias=False),
+                tnn.BatchNorm2d(out_ch),
+            )
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        h = torch.relu(self.bn1(self.conv1(x)))
+        h = self.bn2(self.conv2(h))
+        return torch.relu(h + idn)
+
+
+class _TorchResNet18(tnn.Module):
+    """Hand-built torchvision-layout ResNet-18 (torchvision is not installed;
+    the state_dict keys match torchvision's exactly by attribute naming)."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        widths = [64, 128, 256, 512]
+        in_ch = 64
+        for i, w in enumerate(widths, start=1):
+            stride = 1 if i == 1 else 2
+            setattr(self, f"layer{i}", tnn.Sequential(
+                _TorchBasicBlock(in_ch, w, stride), _TorchBasicBlock(w, w)
+            ))
+            in_ch = w
+        self.avgpool = tnn.AdaptiveAvgPool2d(1)
+        self.fc = tnn.Linear(512, num_classes)
+
+    def forward(self, x):
+        h = self.maxpool(torch.relu(self.bn1(self.conv1(x))))
+        for i in (1, 2, 3, 4):
+            h = getattr(self, f"layer{i}")(h)
+        return self.fc(torch.flatten(self.avgpool(h), 1))
+
+
+def test_imported_resnet18_reproduces_torch_logits():
+    """Converted torchvision-layout ResNet-18 weights + BN running stats must
+    reproduce the torch model's eval-mode logits."""
+    from tpuddp.models import ResNet18
+    from tpuddp.models.torch_import import convert_resnet18_state_dict
+    from tpuddp.nn.core import Context
+
+    torch.manual_seed(3)
+    donor = _TorchResNet18(num_classes=1000)
+    # non-trivial running stats: a few train-mode forwards
+    donor.train()
+    with torch.no_grad():
+        for _ in range(2):
+            donor(torch.randn(4, 3, 64, 64))
+    donor.eval()
+
+    model = ResNet18(num_classes=1000)
+    params, mstate = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    params, mstate = convert_resnet18_state_dict(donor.state_dict(), params, mstate)
+
+    x = np.random.RandomState(0).randn(2, 64, 64, 3).astype(np.float32)
+    ours, _ = model.apply(params, mstate, jnp.asarray(x), Context(train=False))
+    with torch.no_grad():
+        ref = donor(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_pretrained_resnet18_head_swap(tmp_path):
+    from tpuddp.models.torch_import import load_pretrained_resnet18
+
+    torch.manual_seed(4)
+    donor = _TorchResNet18(num_classes=1000)
+    path = tmp_path / "resnet_donor.pt"
+    torch.save(donor.state_dict(), str(path))
+    model, params, mstate = load_pretrained_resnet18(
+        str(path), jax.random.key(0), num_classes=10, image_size=64
+    )
+    assert params[-1]["weight"].shape == (512, 10)
+    conv1 = donor.state_dict()["conv1.weight"].numpy().transpose(2, 3, 1, 0)
+    np.testing.assert_allclose(np.asarray(params[0]["weight"]), conv1, rtol=1e-6)

@@ -319,7 +319,7 @@ class PreparedModel:
                 (loss, new_mstate), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
                 )(params)
-                return loss, self._maybe_clip(grads), new_mstate
+                return loss, grads, new_mstate
 
             self._grad_step = (criterion, jax.jit(grad_step))
         return self._grad_step[1]
@@ -455,6 +455,10 @@ class PreparedOptimizer:
         # fuse_steps > 1: step() queues sharded pending steps here and runs
         # them K at a time as one lax.scan dispatch (flush())
         self._queue = []
+        # gradient_accumulation_steps > 1: running device-side grad sum
+        self._accum_grads = None
+        self._accum_count = 0
+        self._tree_add = None
 
     def zero_grad(self):
         if self.model._pending is not None:
@@ -478,6 +482,20 @@ class PreparedOptimizer:
             model._pending = None
             model._pending_grads = None
             xb, yb, wb = model._shard_xyw(x, y, w)
+            accum = getattr(model.accelerator, "gradient_accumulation_steps", 1)
+            if accum > 1:
+                # grad-only program per micro-batch; ONE averaged (and then
+                # clipped) update every `accum` steps — identical to one step
+                # on the concatenated batch when micro-batches are equal-size
+                fng = model._get_grad_step(criterion)
+                loss, grads, new_mstate = fng(
+                    model._params, model._model_state,
+                    model._bwd_key, step_idx, xb, yb, wb,
+                )
+                model._model_state = new_mstate
+                lazy_loss._value = loss
+                self._accumulate(grads, accum)
+                return
             fuse = getattr(model.accelerator, "fuse_steps", 1)
             if fuse > 1:
                 # queue the sharded step; K of them run as ONE scan dispatch.
@@ -493,14 +511,55 @@ class PreparedOptimizer:
                 return
             self._run_fused(xb, yb, wb, criterion, step_idx, lazy_loss)
             return
-        # grads were materialized early (loss.item() before step()): apply the
-        # update alone, still as a single fused dispatch with donated buffers
-        if self._update is None:
-            self._update = jax.jit(self.optimizer.update, donate_argnums=(1, 2))
-        model.params, self.opt_state = self._update(
-            model._pending_grads, self.opt_state, model.params
-        )
+        # grads were materialized early (loss.item() before step())
+        grads = model._pending_grads
         model._pending_grads = None
+        accum = getattr(model.accelerator, "gradient_accumulation_steps", 1)
+        if accum > 1:
+            # an early loss read must not bypass accumulation (an immediate
+            # full-scale update here would be a silent 4x-LR bug)
+            self._accumulate(grads, accum)
+            return
+        fn = self._get_apply_update()
+        model.params, self.opt_state = fn(grads, self.opt_state, model.params, 1.0)
+
+    def _accumulate(self, grads, accum: int):
+        """Fold one micro-batch's gradient into the running device-side sum;
+        apply ONE averaged (then clipped) update at the cycle boundary."""
+        model = self.model
+        if self._accum_grads is None:
+            self._accum_grads = grads
+        else:
+            if self._tree_add is None:
+                self._tree_add = jax.jit(
+                    lambda a, b: jax.tree_util.tree_map(jnp.add, a, b),
+                    donate_argnums=(0,),
+                )
+            self._accum_grads = self._tree_add(self._accum_grads, grads)
+        self._accum_count += 1
+        if self._accum_count >= accum:
+            fn = self._get_apply_update()
+            model._params, self.opt_state = fn(
+                self._accum_grads, self.opt_state, model._params,
+                1.0 / self._accum_count,
+            )
+            self._accum_grads = None
+            self._accum_count = 0
+
+    def _get_apply_update(self):
+        """Jitted scale -> clip -> optimizer.update (clipping always applies
+        to the final, averaged gradient — never per micro-batch)."""
+        if self._update is None:
+            clip = getattr(self.model.accelerator, "clip_grad_norm", None)
+
+            def apply(grads, opt_state, params, scale):
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+                if clip is not None:
+                    grads, _ = optim_lib.clip_grad_norm_(grads, clip)
+                return self.optimizer.update(grads, opt_state, params)
+
+            self._update = jax.jit(apply, donate_argnums=(0, 1, 2))
+        return self._update
 
     def _run_fused(self, xb, yb, wb, criterion, step_idx, lazy_loss):
         """forward + backward + optimizer update as ONE jit dispatch (the
@@ -590,6 +649,7 @@ class Accelerator:
         fuse_steps: int = 1,
         num_chips: Optional[int] = None,
         clip_grad_norm: Optional[float] = None,
+        gradient_accumulation_steps: int = 1,
     ):
         """``fuse_steps``: K > 1 batches per-step calls into one compiled
         lax.scan dispatch (the managed analog of the native scan fusion) —
@@ -611,6 +671,16 @@ class Accelerator:
         self.clip_grad_norm = (
             float(clip_grad_norm) if clip_grad_norm is not None else None
         )
+        # HF-parity gradient accumulation: optimizer.step() accumulates the
+        # global-batch gradient and applies ONE averaged update every N
+        # steps (zero_grad stays safe to call every batch, as HF's managed
+        # no-op semantics allow; the boundary step clears the accumulator).
+        self.gradient_accumulation_steps = max(1, int(gradient_accumulation_steps))
+        if self.gradient_accumulation_steps > 1 and self.fuse_steps > 1:
+            raise ValueError(
+                "gradient_accumulation_steps and fuse_steps are mutually "
+                "exclusive (fused scan steps each apply an update)"
+            )
 
     # -- topology (HF property-name parity) --
     @property

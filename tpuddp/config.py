@@ -43,7 +43,8 @@ TRAINING_DEFAULTS = {
     "prefetch": True,  # background-thread host batch prefetch
     "deferred_metrics": False,  # managed path: epoch-end (not per-batch) metric sync
     "fuse_steps": "auto",  # managed path: K step()s per dispatch (auto: 8 if deferred)
-    "pretrained_path": None,  # torch state_dict to fine-tune from (AlexNet)
+    "gradient_accumulation_steps": 1,  # managed path: averaged update every N steps
+    "pretrained_path": None,  # torch checkpoint to fine-tune from (alexnet | resnet18)
     "num_classes": None,  # None -> derived from training.dataset
 }
 

@@ -1,4 +1,4 @@
-"""Import torch/torchvision AlexNet weights into tpuddp's AlexNet.
+"""Import torch/torchvision checkpoints into tpuddp models (AlexNet, ResNet-18).
 
 The reference starts from *pretrained* torchvision AlexNet weights
 (data_and_toy_model.py:41-43). This build runs zero-egress, so pretrained
@@ -107,25 +107,138 @@ def load_pretrained_alexnet(
     widths differ. Returns ``(model, params, model_state)`` ready for
     ``DistributedDataParallel.init_state`` / ``Accelerator.prepare``.
     """
+    from tpuddp.models.alexnet import AlexNet
+
+    return _load_pretrained(
+        path, key, num_classes, image_size,
+        build=lambda n: AlexNet(num_classes=n),
+        head_weight_key="classifier.6.weight",
+        convert=lambda sd, p, s: (convert_alexnet_state_dict(sd, p), s),
+        salt=0x9e7,
+    )
+
+
+def _load_pretrained(
+    path, key, num_classes, image_size, build, head_weight_key, convert, salt
+):
+    """Shared fine-tune loader: torch.load + module unwrap + build the model
+    sized to the checkpoint's own head + convert + swap the head when widths
+    differ. One implementation for every architecture-specific converter."""
     import jax
     import torch
 
-    from tpuddp.models.alexnet import AlexNet, replace_head
+    from tpuddp.models.alexnet import replace_head
 
     state_dict = torch.load(path, map_location="cpu", weights_only=True)
     if hasattr(state_dict, "state_dict"):
         state_dict = state_dict.state_dict()
-    head_out = int(_to_np(state_dict["classifier.6.weight"]).shape[0])
+    head_out = int(_to_np(state_dict[head_weight_key]).shape[0])
 
-    model = AlexNet(num_classes=head_out)
-    init_key, head_key = jax.random.split(jax.random.fold_in(key, 0x9e7))
+    model = build(head_out)
+    init_key, head_key = jax.random.split(jax.random.fold_in(key, salt))
     params, model_state = model.init(
         init_key, jnp.zeros((1, image_size, image_size, 3))
     )
-    params = convert_alexnet_state_dict(state_dict, params)
+    params, model_state = convert(state_dict, params, model_state)
     if head_out != num_classes:
         params = replace_head(model, params, head_key, num_classes)
     return model, params, model_state
+
+
+def _conv_w(sd, key):
+    return jnp.asarray(np.transpose(_to_np(sd[f"{key}.weight"]), (2, 3, 1, 0)))
+
+
+def _bn(sd, key):
+    params = {
+        "scale": jnp.asarray(_to_np(sd[f"{key}.weight"])),
+        "bias": jnp.asarray(_to_np(sd[f"{key}.bias"])),
+    }
+    state = {
+        "mean": jnp.asarray(_to_np(sd[f"{key}.running_mean"])),
+        "var": jnp.asarray(_to_np(sd[f"{key}.running_var"])),
+    }
+    return params, state
+
+
+def _checked(tag: str, new: Dict, expect) -> Dict:
+    """Validate EVERY imported tensor's shape against the initialized tree
+    before assignment — a width-variant or truncated checkpoint must fail
+    here with a named tensor, not deep inside XLA at first apply."""
+    for k, arr in new.items():
+        if isinstance(arr, dict):
+            exp_sub = expect.get(k) if isinstance(expect, dict) else None
+            if exp_sub is None:
+                raise ValueError(f"{tag}.{k}: unexpected parameter group")
+            _checked(f"{tag}.{k}", arr, exp_sub)
+            continue
+        exp = expect.get(k) if isinstance(expect, dict) else None
+        if exp is None or tuple(arr.shape) != tuple(exp.shape):
+            raise ValueError(
+                f"{tag}.{k}: shape {tuple(arr.shape)} != expected "
+                f"{None if exp is None else tuple(exp.shape)}"
+            )
+    return new
+
+
+def convert_resnet18_state_dict(state_dict: Mapping[str, object], params, model_state):
+    """Map a torchvision-layout ResNet-18 ``state_dict`` (conv1/bn1,
+    layer{1-4}.{0,1}.*, fc) onto tpuddp's full-stem ResNet-18 Sequential
+    (tpuddp/models/resnet.py). Returns ``(params, model_state)`` — unlike
+    AlexNet, ResNet carries BatchNorm running statistics in the model state,
+    which must ride along for eval-mode parity."""
+    new_p, new_s = list(params), list(model_state)
+    # stem: Sequential[0]=Conv2d(64,7,s2), [1]=BatchNorm ([2] ReLU, [3] MaxPool)
+    new_p[0] = _checked("conv1", {"weight": _conv_w(state_dict, "conv1")}, new_p[0])
+    bn_p, bn_s = _bn(state_dict, "bn1")
+    new_p[1] = _checked("bn1", bn_p, new_p[1])
+    new_s[1] = _checked("bn1(state)", bn_s, new_s[1])
+    base = 4  # first BasicBlock index in the full-stem Sequential
+    idx = base
+    for stage in (1, 2, 3, 4):
+        for block in (0, 1):
+            t = f"layer{stage}.{block}"
+            p = {
+                "conv1": {"weight": _conv_w(state_dict, f"{t}.conv1")},
+                "conv2": {"weight": _conv_w(state_dict, f"{t}.conv2")},
+            }
+            s = {}
+            p["bn1"], s["bn1"] = _bn(state_dict, f"{t}.bn1")
+            p["bn2"], s["bn2"] = _bn(state_dict, f"{t}.bn2")
+            if f"{t}.downsample.0.weight" in state_dict:
+                p["down_conv"] = {"weight": _conv_w(state_dict, f"{t}.downsample.0")}
+                p["down_bn"], s["down_bn"] = _bn(state_dict, f"{t}.downsample.1")
+            new_p[idx] = _checked(t, p, new_p[idx])
+            new_s[idx] = _checked(f"{t}(state)", s, new_s[idx])
+            idx += 1
+    # head: GAP at -2 (no params), Linear at -1
+    w = _to_np(state_dict["fc.weight"]).T
+    b = _to_np(state_dict["fc.bias"])
+    if w.shape != tuple(new_p[-1]["weight"].shape):
+        raise ValueError(f"fc: shape {w.shape} != {new_p[-1]['weight'].shape}")
+    new_p[-1] = {"weight": jnp.asarray(w), "bias": jnp.asarray(b)}
+    return tuple(new_p), tuple(new_s)
+
+
+def load_pretrained_resnet18(path: str, key, num_classes: int = 10, image_size: int = 224):
+    """ResNet-18 analog of :func:`load_pretrained_alexnet`: build the model
+    sized to the checkpoint's own head, import weights + BN statistics, swap
+    in a fresh ``num_classes`` head when the widths differ."""
+    from tpuddp.models.resnet import ResNet18
+
+    return _load_pretrained(
+        path, key, num_classes, image_size,
+        build=lambda n: ResNet18(num_classes=n),
+        head_weight_key="fc.weight",
+        convert=convert_resnet18_state_dict,
+        salt=0x9e8,
+    )
+
+
+_PRETRAINED_LOADERS = {
+    "alexnet": load_pretrained_alexnet,
+    "resnet18": load_pretrained_resnet18,
+}
 
 
 def pretrained_from_config(training: Mapping[str, object], key=None):
@@ -135,16 +248,17 @@ def pretrained_from_config(training: Mapping[str, object], key=None):
     ``(model, params, model_state)``."""
     import jax
 
-    if training["model"] != "alexnet":
+    loader = _PRETRAINED_LOADERS.get(str(training["model"]))
+    if loader is None:
         raise ValueError(
-            "training.pretrained_path supports model 'alexnet' "
-            f"(got {training['model']!r})"
+            "training.pretrained_path supports models "
+            f"{sorted(_PRETRAINED_LOADERS)} (got {training['model']!r})"
         )
     if key is None:
         key = jax.random.key(int(training.get("seed") or 0))
     from tpuddp.config import num_classes_from
 
-    return load_pretrained_alexnet(
+    return loader(
         str(training["pretrained_path"]),
         key,
         num_classes=num_classes_from(training),

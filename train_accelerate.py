@@ -186,14 +186,19 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
     # num_chips honors a configured sub-world on multi-chip hosts.
     # fuse_steps batches K optimizer.step()s into one scan dispatch; it only
     # pays off when loss reads are deferred, so "auto" keys off that.
+    accum = int(training.get("gradient_accumulation_steps") or 1)
     fuse = training.get("fuse_steps", "auto")
     if fuse in (None, "auto"):
-        fuse = 8 if training.get("deferred_metrics") else 1
+        # auto fusion only when it composes: accumulation owns the step cadence
+        fuse = 8 if (training.get("deferred_metrics") and accum == 1) else 1
+    # an EXPLICIT fuse_steps conflicting with accumulation surfaces the
+    # library's own mutually-exclusive error instead of a silent override
     accelerator = Accelerator(
         seed=training.get("seed"),
         fuse_steps=int(fuse),
         num_chips=num_chips,
         clip_grad_norm=training.get("clip_grad_norm"),
+        gradient_accumulation_steps=accum,
     )
 
     # Data + model (reference :118-122); placement is implicit on this path.

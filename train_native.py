@@ -87,7 +87,10 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         from tpuddp.models.torch_import import pretrained_from_config
 
         model, init_params, init_mstate = pretrained_from_config(training, key)
-        print(f"Loaded pretrained AlexNet weights from {training['pretrained_path']}.")
+        print(
+            f"Loaded pretrained {training['model']} weights from "
+            f"{training['pretrained_path']}."
+        )
     else:
         model = load_model(training["model"], cfg_lib.num_classes_from(training))
     if training.get("sync_bn"):
