@@ -455,3 +455,32 @@ def test_gradient_accumulation_matches_big_batch(mesh):
 def test_accumulation_and_fuse_steps_are_exclusive(mesh):
     with pytest.raises(ValueError, match="exclusive"):
         Accelerator(mesh=mesh, fuse_steps=4, gradient_accumulation_steps=2)
+
+
+def test_partial_accumulation_cycle_flushes(mesh):
+    """A partial cycle must be applied (averaged over the micro-batches seen)
+    by flush_accumulation — the HF dataloader-end contract — not leaked into
+    the next epoch or dropped."""
+    acc = Accelerator(mesh=mesh, seed=7, gradient_accumulation_steps=4)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(1.0))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+    model(x)
+    p0 = jax.tree_util.tree_map(np.asarray, model.params)
+    for _ in range(2):  # partial cycle: 2 of 4
+        loss = criterion(model(x), y)
+        acc.backward(loss)
+        opt.step()
+    assert opt._accum_count == 2
+    opt.flush_accumulation()
+    assert opt._accum_count == 0 and opt._accum_grads is None
+    moved = any(
+        bool(np.any(np.asarray(a) != b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(model.params),
+            jax.tree_util.tree_leaves(p0),
+        )
+    )
+    assert moved
+    opt.flush_accumulation()  # no-op when empty

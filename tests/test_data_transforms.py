@@ -151,3 +151,15 @@ def test_augment_is_jittable():
     f = jax.jit(aug)
     out = f(jax.random.key(0), jnp.zeros((2, 32, 32, 3), jnp.uint8))
     assert out.shape == (2, 48, 48, 3)
+
+
+def test_compute_dtype_config():
+    import jax.numpy as jnp
+
+    from tpuddp.data import compute_dtype_for
+
+    assert compute_dtype_for({}) == jnp.float32
+    assert compute_dtype_for({"compute_dtype": "bfloat16"}) == jnp.bfloat16
+    assert compute_dtype_for({"compute_dtype": "bf16"}) == jnp.bfloat16
+    with pytest.raises(ValueError, match="compute_dtype"):
+        compute_dtype_for({"compute_dtype": "float16x"})

@@ -179,3 +179,24 @@ def test_pretrained_resnet18_head_swap(tmp_path):
     assert params[-1]["weight"].shape == (512, 10)
     conv1 = donor.state_dict()["conv1.weight"].numpy().transpose(2, 3, 1, 0)
     np.testing.assert_allclose(np.asarray(params[0]["weight"]), conv1, rtol=1e-6)
+
+
+def test_resnet_import_rejects_missing_downsample(tmp_path):
+    """A checkpoint lacking a stride-2 block's downsample tensors must fail
+    with a named-tensor error, not a raw shape mismatch deep inside JAX."""
+    from tpuddp.models import ResNet18
+    from tpuddp.models.torch_import import convert_resnet18_state_dict
+
+    torch.manual_seed(5)
+    donor = _TorchResNet18(num_classes=10)
+    sd = dict(donor.state_dict())
+    del sd["layer2.0.downsample.0.weight"]
+    del sd["layer2.0.downsample.1.weight"]
+    del sd["layer2.0.downsample.1.bias"]
+    del sd["layer2.0.downsample.1.running_mean"]
+    del sd["layer2.0.downsample.1.running_var"]
+
+    model = ResNet18(num_classes=10)
+    params, mstate = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    with pytest.raises(ValueError, match="layer2.0.*down"):
+        convert_resnet18_state_dict(sd, params, mstate)

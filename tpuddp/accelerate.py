@@ -538,13 +538,24 @@ class PreparedOptimizer:
             self._accum_grads = self._tree_add(self._accum_grads, grads)
         self._accum_count += 1
         if self._accum_count >= accum:
-            fn = self._get_apply_update()
-            model._params, self.opt_state = fn(
-                self._accum_grads, self.opt_state, model._params,
-                1.0 / self._accum_count,
-            )
-            self._accum_grads = None
-            self._accum_count = 0
+            self.flush_accumulation()
+
+    def flush_accumulation(self):
+        """Apply any partially-accumulated cycle now (averaged over the
+        micro-batches actually seen) — the dataloader-end behavior of HF's
+        ``accumulate()``. No-op when nothing is accumulated. Call at epoch
+        end so a partial cycle neither leaks into the next epoch nor gets
+        silently dropped at training end."""
+        if self._accum_count == 0:
+            return
+        model = self.model
+        fn = self._get_apply_update()
+        model._params, self.opt_state = fn(
+            self._accum_grads, self.opt_state, model._params,
+            1.0 / self._accum_count,
+        )
+        self._accum_grads = None
+        self._accum_count = 0
 
     def _get_apply_update(self):
         """Jitted scale -> clip -> optimizer.update (clipping always applies

@@ -32,6 +32,8 @@ TRAINING_DEFAULTS = {
     "checkpoint_epoch": 5,  # :167
     "image_size": 224,  # data_and_toy_model.py:14
     "flip": None,  # RandomHorizontalFlip (:15); None -> on except for digits
+    "compute_dtype": "float32",  # activation dtype: bfloat16 = mixed precision
+    # (f32 master params; bf16 activations through the MXU — BASELINE.md)
     "seed": None,  # None -> fresh per run, like torch initial_seed
     "mode": "shard_map",
     "sync_bn": False,

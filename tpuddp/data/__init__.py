@@ -51,6 +51,21 @@ def flip_for(training: Dict[str, Any]) -> bool:
     return str(training.get("dataset") or "cifar10") != "digits"
 
 
+def compute_dtype_for(training: Dict[str, Any]):
+    """Activation dtype for the device-side transforms: ``bfloat16`` is the
+    TPU mixed-precision mode (f32 master params, bf16 activations on the
+    MXU; see BASELINE.md's bf16-vs-f32 analysis)."""
+    import jax.numpy as jnp
+
+    name = str(training.get("compute_dtype") or "float32")
+    table = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16}
+    if name not in table:
+        raise ValueError(
+            f"unknown training.compute_dtype {name!r}; one of float32, bfloat16"
+        )
+    return table[name]
+
+
 def norm_stats_for(training: Dict[str, Any]) -> Tuple[Sequence[float], Sequence[float]]:
     """Per-dataset normalization (mean, std) for the device-side transforms
     (the reference bakes CIFAR constants into its torchvision pipeline,
@@ -73,4 +88,5 @@ __all__ = [
     "load_datasets_for",
     "norm_stats_for",
     "flip_for",
+    "compute_dtype_for",
 ]

@@ -208,6 +208,14 @@ def convert_resnet18_state_dict(state_dict: Mapping[str, object], params, model_
             if f"{t}.downsample.0.weight" in state_dict:
                 p["down_conv"] = {"weight": _conv_w(state_dict, f"{t}.downsample.0")}
                 p["down_bn"], s["down_bn"] = _bn(state_dict, f"{t}.downsample.1")
+            missing_p = set(new_p[idx]) - set(p)
+            missing_s = set(new_s[idx]) - set(s)
+            if missing_p or missing_s:
+                raise ValueError(
+                    f"{t}: checkpoint lacks expected tensors "
+                    f"{sorted(missing_p | missing_s)} (truncated file or a "
+                    "different shortcut variant)"
+                )
             new_p[idx] = _checked(t, p, new_p[idx])
             new_s[idx] = _checked(f"{t}(state)", s, new_s[idx])
             idx += 1

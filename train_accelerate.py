@@ -23,7 +23,13 @@ import numpy as np
 from tpuddp import config as cfg_lib
 from tpuddp import nn, optim
 from tpuddp.accelerate import Accelerator
-from tpuddp.data import DataLoader, flip_for, load_datasets_for, norm_stats_for
+from tpuddp.data import (
+    DataLoader,
+    compute_dtype_for,
+    flip_for,
+    load_datasets_for,
+    norm_stats_for,
+)
 from tpuddp.data.transforms import make_eval_transform, make_train_augment
 
 logging.basicConfig(level=logging.INFO, format="%(message)s")
@@ -70,6 +76,11 @@ def train(
             batch_losses.append(loss)
         else:
             running_loss += loss.item()  # per-batch host sync (Q5 parity mode)
+    # a partial gradient-accumulation cycle applies at dataloader end (the
+    # HF accumulate() contract) instead of leaking into the next epoch
+    flush_accum = getattr(optimizer, "flush_accumulation", None)
+    if flush_accum is not None:
+        flush_accum()
     if deferred:
         # Sum on device (array-at-a-time over fused flushes), ONE host fetch
         # — per-batch scalar reads cost a dispatch each and dominate the
@@ -218,16 +229,21 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
     # jitted so each runs as one fused device op, not eager op-by-op;
     # normalization stats follow the dataset, flip is a config knob
     mean, std = norm_stats_for(training)
+    cdtype = compute_dtype_for(training)
     augment = jax.jit(
         make_train_augment(
             size=training.get("image_size"),
             flip=flip_for(training),
             mean=mean,
             std=std,
+            compute_dtype=cdtype,
         )
     )
     eval_transform = jax.jit(
-        make_eval_transform(size=training.get("image_size"), mean=mean, std=std)
+        make_eval_transform(
+            size=training.get("image_size"), mean=mean, std=std,
+            compute_dtype=cdtype,
+        )
     )
     run_training_loop(
         model,

@@ -28,6 +28,7 @@ from tpuddp import nn, optim, seeding
 from tpuddp.data import (
     PrefetchLoader,
     ShardedDataLoader,
+    compute_dtype_for,
     flip_for,
     load_datasets_for,
     norm_stats_for,
@@ -75,10 +76,14 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
     # (digits are not flip-invariant, unlike CIFAR photos).
     size = training.get("image_size")
     mean, std = norm_stats_for(training)
+    cdtype = compute_dtype_for(training)
     augment = make_train_augment(
-        size=size, flip=flip_for(training), mean=mean, std=std
+        size=size, flip=flip_for(training), mean=mean, std=std,
+        compute_dtype=cdtype,
     )
-    eval_transform = make_eval_transform(size=size, mean=mean, std=std)
+    eval_transform = make_eval_transform(
+        size=size, mean=mean, std=std, compute_dtype=cdtype
+    )
 
     # Model, optionally fine-tuning from a torch checkpoint on disk — the
     # reference's central pretrained-AlexNet workflow (data_and_toy_model.py:41-45).
