@@ -484,3 +484,59 @@ def test_partial_accumulation_cycle_flushes(mesh):
     )
     assert moved
     opt.flush_accumulation()  # no-op when empty
+
+
+def test_accumulation_rejects_double_backward(mesh):
+    """The torch-canonical N-backwards-then-one-step pattern must raise under
+    gradient accumulation, not silently drop micro-batch gradients."""
+    acc = Accelerator(mesh=mesh, seed=8, gradient_accumulation_steps=4)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(0.1))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+    acc.backward(criterion(model(x), y))
+    with pytest.raises(RuntimeError, match="EACH"):
+        acc.backward(criterion(model(x), y))
+
+
+def test_load_model_clears_stale_accumulation(acc_accum_factory=None):
+    """load_model must not let gradients of the pre-restore weights apply on
+    top of the restored weights."""
+    import tempfile
+
+    from tpuddp.parallel import make_mesh
+
+    mesh = make_mesh(jax.devices("cpu")[:8])
+    acc = Accelerator(mesh=mesh, seed=9, gradient_accumulation_steps=4)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(1.0))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+    model(x)
+    with tempfile.TemporaryDirectory() as d:
+        acc.save_model(model, d)
+        saved = jax.tree_util.tree_map(np.asarray, model.params)
+        for _ in range(2):  # mid-cycle accumulation
+            loss = criterion(model(x), y)
+            acc.backward(loss)
+            opt.step()
+        assert opt._accum_count == 2
+        acc.load_model(model, d)
+        assert opt._accum_count == 0 and opt._accum_grads is None
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+            model.params, saved,
+        )
+        # a fresh cycle works normally after the restore
+        for _ in range(4):
+            loss = criterion(model(x), y)
+            acc.backward(loss)
+            opt.step()
+        moved = any(
+            bool(np.any(np.asarray(a) != b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(model.params),
+                jax.tree_util.tree_leaves(saved),
+            )
+        )
+        assert moved

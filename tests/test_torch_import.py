@@ -200,3 +200,23 @@ def test_resnet_import_rejects_missing_downsample(tmp_path):
     params, mstate = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
     with pytest.raises(ValueError, match="layer2.0.*down"):
         convert_resnet18_state_dict(sd, params, mstate)
+
+
+def test_resnet_import_rejects_deeper_variant():
+    """A ResNet-34 checkpoint (shape-compatible early blocks) must not import
+    silently into ResNet-18 with half its blocks dropped."""
+    from tpuddp.models import ResNet18
+    from tpuddp.models.torch_import import convert_resnet18_state_dict
+
+    torch.manual_seed(6)
+    donor = _TorchResNet18(num_classes=10)
+    sd = dict(donor.state_dict())
+    # fabricate an extra layer1.2 block (what a ResNet-34 checkpoint carries)
+    for k in list(sd):
+        if k.startswith("layer1.1."):
+            sd[k.replace("layer1.1.", "layer1.2.")] = sd[k].clone()
+
+    model = ResNet18(num_classes=10)
+    params, mstate = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    with pytest.raises(ValueError, match="does not consume"):
+        convert_resnet18_state_dict(sd, params, mstate)

@@ -347,6 +347,14 @@ class PreparedModel:
         step — an eager ``jax.random.split`` per batch would be a device
         dispatch of its own (measured ~3 ms through a tunneled runtime)."""
         if self._pending is not None:
+            if getattr(self.accelerator, "gradient_accumulation_steps", 1) > 1:
+                raise RuntimeError(
+                    "gradient accumulation requires optimizer.step() after "
+                    "EACH accelerator.backward(): the step is accumulated, "
+                    "not applied, until the cycle boundary — a second "
+                    "backward here would silently drop the previous "
+                    "micro-batch's gradient."
+                )
             old = self._pending[-1]
             if old._value is None:
                 old._dropped = True
@@ -521,7 +529,13 @@ class PreparedOptimizer:
             self._accumulate(grads, accum)
             return
         fn = self._get_apply_update()
-        model.params, self.opt_state = fn(grads, self.opt_state, model.params, 1.0)
+        try:
+            model.params, self.opt_state = fn(
+                grads, self.opt_state, model.params, 1.0
+            )
+        except BaseException:
+            self._poison_if_donated()
+            raise
 
     def _accumulate(self, grads, accum: int):
         """Fold one micro-batch's gradient into the running device-side sum;
@@ -550,10 +564,14 @@ class PreparedOptimizer:
             return
         model = self.model
         fn = self._get_apply_update()
-        model._params, self.opt_state = fn(
-            self._accum_grads, self.opt_state, model._params,
-            1.0 / self._accum_count,
-        )
+        try:
+            model._params, self.opt_state = fn(
+                self._accum_grads, self.opt_state, model._params,
+                1.0 / self._accum_count,
+            )
+        except BaseException:
+            self._poison_if_donated()
+            raise
         self._accum_grads = None
         self._accum_count = 0
 
@@ -572,15 +590,31 @@ class PreparedOptimizer:
             self._update = jax.jit(apply, donate_argnums=(0, 1, 2))
         return self._update
 
+    def _poison_if_donated(self):
+        """After a failed dispatch that may have donated the model/optimizer
+        buffers: poison the model so reads raise the clear restore-from-
+        checkpoint error, not JAX's obscure 'Array has been deleted'."""
+        model = self.model
+        leaves = jax.tree_util.tree_leaves(
+            (model._params, model._model_state, self.opt_state)
+        )
+        if any(getattr(l, "is_deleted", lambda: False)() for l in leaves):
+            model._params = model._model_state = _LOST_TO_FAILED_FLUSH
+            self.opt_state = None
+
     def _run_fused(self, xb, yb, wb, criterion, step_idx, lazy_loss):
         """forward + backward + optimizer update as ONE jit dispatch (the
         managed analog of the native compiled train step)."""
         model = self.model
         fn = model._get_fused_step(criterion, self.optimizer)
-        loss, new_params, new_mstate, new_opt = fn(
-            model._params, model._model_state, self.opt_state,
-            model._bwd_key, step_idx, xb, yb, wb,
-        )
+        try:
+            loss, new_params, new_mstate, new_opt = fn(
+                model._params, model._model_state, self.opt_state,
+                model._bwd_key, step_idx, xb, yb, wb,
+            )
+        except BaseException:
+            self._poison_if_donated()
+            raise
         model._params, model._model_state = new_params, new_mstate
         self.opt_state = new_opt
         lazy_loss._value = loss
@@ -610,15 +644,8 @@ class PreparedOptimizer:
                         "exception)"
                     )
             # Donation only happens if execution started; a trace/compile
-            # failure leaves the buffers valid. If they WERE donated, poison
-            # the model so later params reads raise a clear error instead of
-            # JAX's obscure 'Array has been deleted'.
-            leaves = jax.tree_util.tree_leaves(
-                (model._params, model._model_state, self.opt_state)
-            )
-            if any(getattr(l, "is_deleted", lambda: False)() for l in leaves):
-                model._params = model._model_state = _LOST_TO_FAILED_FLUSH
-                self.opt_state = None
+            # failure leaves the buffers valid.
+            self._poison_if_donated()
             raise
 
     def _dispatch_flush(self, queue):
@@ -757,6 +784,7 @@ class Accelerator:
                 if model_ctx is None:
                     raise ValueError("prepare() got an optimizer but no model")
                 out[i] = PreparedOptimizer(obj[1], model_ctx)
+                model_ctx._optimizer = out[i]  # for load_model's reset
         out = [
             ShardedDataLoader(
                 o.dataset, o.batch_size, self.mesh,
@@ -822,6 +850,19 @@ class Accelerator:
         model._params, model._model_state = replicate(
             self.mesh, (restored["params"], restored["model_state"])
         )
+        # gradients/steps computed against the PRE-restore weights must not
+        # be applied on top of the restored ones
+        model._pending = None
+        model._pending_grads = None
+        opt = getattr(model, "_optimizer", None)
+        if opt is not None:
+            for entry in opt._queue:
+                entry[5]._queued_on = None
+                entry[5]._dropped = True
+                entry[5]._drop_reason = "load_model discarded the queued step"
+            opt._queue = []
+            opt._accum_grads = None
+            opt._accum_count = 0
         return model
 
     def gather(self, x):

@@ -152,17 +152,18 @@ def rendezvous_from(settings: Dict[str, Any]) -> Dict[str, Any]:
             "coordinator_address, num_processes, process_id"
         )
     if out.get("num_processes", 1) > 1:
-        if not out.get("coordinator_address") and device_from(settings) == "cpu":
-            # On the CPU dev rung there is no auto-discovery: without a
-            # coordinator the request skips the dev re-exec (which gates on
-            # it) yet still reaches jax.distributed.initialize(None, ...),
-            # which dies late with an obscure runtime error. On TPU pods a
-            # missing coordinator/process_id is VALID — initialize()
-            # auto-discovers peers from the pod environment (backend.setup).
+        if not out.get("coordinator_address") and device_from(settings) != "tpu":
+            # Only TPU pods can auto-discover peers (initialize() reads the
+            # pod environment; set local.device: tpu to use that). Anywhere
+            # else — cpu, unset, or a migrated cuda settings file — a missing
+            # coordinator would skip the dev re-exec (which gates on it) yet
+            # still reach jax.distributed.initialize(None, ...), dying late
+            # with an obscure runtime error; fail clearly here instead.
             raise ValueError(
-                "local.rendezvous with num_processes > 1 on the CPU backend "
-                "needs a coordinator_address (host:port of process 0; set "
-                "TPUDDP_COORDINATOR, or the YAML key)"
+                "local.rendezvous with num_processes > 1 needs a "
+                "coordinator_address (host:port of process 0; set "
+                "TPUDDP_COORDINATOR or the YAML key) — or local.device: tpu "
+                "to use TPU pod auto-discovery"
             )
         if out.get("coordinator_address") and "process_id" not in out:
             raise ValueError(

@@ -187,6 +187,17 @@ def convert_resnet18_state_dict(state_dict: Mapping[str, object], params, model_
     (tpuddp/models/resnet.py). Returns ``(params, model_state)`` — unlike
     AlexNet, ResNet carries BatchNorm running statistics in the model state,
     which must ride along for eval-mode parity."""
+    consumed: set = set()
+
+    class _Recording(dict):
+        def __getitem__(self, k):
+            consumed.add(k)
+            return dict.__getitem__(self, k)
+
+        def __contains__(self, k):
+            return dict.__contains__(self, k)
+
+    state_dict = _Recording(state_dict)
     new_p, new_s = list(params), list(model_state)
     # stem: Sequential[0]=Conv2d(64,7,s2), [1]=BatchNorm ([2] ReLU, [3] MaxPool)
     new_p[0] = _checked("conv1", {"weight": _conv_w(state_dict, "conv1")}, new_p[0])
@@ -225,6 +236,18 @@ def convert_resnet18_state_dict(state_dict: Mapping[str, object], params, model_
     if w.shape != tuple(new_p[-1]["weight"].shape):
         raise ValueError(f"fc: shape {w.shape} != {new_p[-1]['weight'].shape}")
     new_p[-1] = {"weight": jnp.asarray(w), "bias": jnp.asarray(b)}
+    # Unconsumed tensors mean the checkpoint is a DIFFERENT architecture
+    # whose early blocks happen to be shape-compatible (e.g. a ResNet-34
+    # imported as ResNet-18 would silently drop half its blocks).
+    leftover = sorted(
+        k for k in state_dict
+        if k not in consumed and not k.endswith("num_batches_tracked")
+    )
+    if leftover:
+        raise ValueError(
+            f"checkpoint has {len(leftover)} tensors this ResNet-18 layout "
+            f"does not consume (e.g. {leftover[:3]}); wrong architecture?"
+        )
     return tuple(new_p), tuple(new_s)
 
 

@@ -101,6 +101,13 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
     if training.get("sync_bn"):
         nn.convert_sync_batchnorm(model)
 
+    if int(training.get("gradient_accumulation_steps") or 1) > 1:
+        raise ValueError(
+            "gradient_accumulation_steps is a managed-path "
+            "(train_accelerate.py) feature; the native path reaches large "
+            "effective batches directly via train_batch_size"
+        )
+
     # Loss + optimizer (reference :248-249).
     criterion = nn.CrossEntropyLoss()
     optimizer = optim.Adam(lr=training["learning_rate"])
