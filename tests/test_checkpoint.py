@@ -74,6 +74,39 @@ def test_missing_leaf_raises(tmp_path):
         raise AssertionError("expected KeyError")
 
 
+def test_shape_mismatch_raises_named_leaf(tmp_path):
+    """A same-layout checkpoint with different widths (e.g. a 12-class head
+    into a 10-class model) must fail loudly, like torch load_state_dict."""
+    path = ckpt.save(str(tmp_path / "s.npz"), {"w": jnp.zeros((12, 4))})
+    try:
+        ckpt.load(path, {"w": jnp.zeros((10, 4))})
+    except ValueError as e:
+        assert "['w']" in str(e)  # the offending leaf is named (keystr form)
+        assert "(12, 4)" in str(e) and "(10, 4)" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
+def test_dtype_mismatch_raises(tmp_path):
+    path = ckpt.save(str(tmp_path / "s.npz"), {"w": jnp.zeros((4,), jnp.bfloat16)})
+    try:
+        ckpt.load(path, {"w": jnp.zeros((4,), jnp.float32)})
+    except ValueError as e:
+        assert "dtype" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
+def test_prng_key_shape_mismatch_raises(tmp_path):
+    path = ckpt.save(str(tmp_path / "s.npz"), {"rng": jax.random.split(jax.random.key(0), 4)})
+    try:
+        ckpt.load(path, {"rng": jax.random.key(0)})
+    except ValueError as e:
+        assert "key-data shape" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
 def test_no_tmp_file_left_behind(tmp_path):
     _, state = make_state()
     ckpt.save(str(tmp_path / "s.npz"), state)

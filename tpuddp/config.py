@@ -46,6 +46,8 @@ TRAINING_DEFAULTS = {
     "deferred_metrics": False,  # managed path: epoch-end (not per-batch) metric sync
     "fuse_steps": "auto",  # managed path: K step()s per dispatch (auto: 8 if deferred)
     "gradient_accumulation_steps": 1,  # managed path: averaged update every N steps
+    "optimizer_state_dtype": None,  # Adam m/v storage dtype ("bfloat16" halves
+    # optimizer HBM traffic; math stays f32). None -> params' dtype.
     "pretrained_path": None,  # torch checkpoint to fine-tune from (alexnet | resnet18)
     "num_classes": None,  # None -> derived from training.dataset
 }

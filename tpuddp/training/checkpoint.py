@@ -24,11 +24,15 @@ import re
 from typing import Any, Optional, Tuple
 
 import jax
+import ml_dtypes
 import numpy as np
 
 from tpuddp.parallel import collectives as col
 
 _KEY_MARK = "__prngkey__"
+_BF16_MARK = "__bf16__"  # npz can't serialize ml_dtypes natively (loads back
+# as void16); bf16 leaves — e.g. Adam moments under optimizer_state_dtype —
+# are stored as a uint16 bit view and re-viewed on load.
 
 
 def _path_str(path) -> str:
@@ -44,6 +48,8 @@ def save(path: str, tree: Any) -> str:
         arr = leaf
         if hasattr(arr, "dtype") and jax.dtypes.issubdtype(arr.dtype, jax.dtypes.prng_key):
             payload[_KEY_MARK + key] = np.asarray(jax.random.key_data(arr))
+        elif hasattr(arr, "dtype") and arr.dtype == ml_dtypes.bfloat16:
+            payload[_BF16_MARK + key] = np.asarray(arr).view(np.uint16)
         else:
             payload[key] = np.asarray(arr)
     tmp = path + ".tmp"
@@ -53,8 +59,31 @@ def save(path: str, tree: Any) -> str:
     return path
 
 
+def _check_leaf(path: str, key: str, stored: np.ndarray, template: Any) -> np.ndarray:
+    """Shape/dtype validation against the template leaf — the analog of
+    torch ``load_state_dict``'s size-mismatch error. A same-layout checkpoint
+    with different widths (e.g. a 12-class head into a 10-class model) must
+    fail loudly here, not train silently with wrong-width logits."""
+    t_shape = tuple(np.shape(template))
+    t_dtype = np.asarray(template).dtype if not hasattr(template, "dtype") else template.dtype
+    if tuple(stored.shape) != t_shape:
+        raise ValueError(
+            f"checkpoint {path}: leaf {key!r} has shape {tuple(stored.shape)} "
+            f"but the model expects {t_shape}"
+        )
+    if stored.dtype != t_dtype:
+        raise ValueError(
+            f"checkpoint {path}: leaf {key!r} has dtype {stored.dtype} but "
+            f"the model expects {t_dtype} (if this is optimizer state, check "
+            "training.optimizer_state_dtype matches the saved run)"
+        )
+    return stored
+
+
 def load(path: str, like: Any) -> Any:
-    """Restore a pytree saved by :func:`save`, using ``like`` for structure."""
+    """Restore a pytree saved by :func:`save`, using ``like`` for structure.
+    Leaf shapes and dtypes are validated against ``like``; mismatches raise
+    with the offending leaf named."""
     with np.load(path) as data:
         stored = dict(data.items())
     flat, treedef = jax.tree_util.tree_flatten_with_path(like)
@@ -62,9 +91,28 @@ def load(path: str, like: Any) -> Any:
     for p, template in flat:
         key = _path_str(p)
         if key in stored:
-            leaves.append(stored[key])
+            leaves.append(_check_leaf(path, key, stored[key], template))
+        elif _BF16_MARK + key in stored:
+            arr = stored[_BF16_MARK + key].view(ml_dtypes.bfloat16)
+            leaves.append(_check_leaf(path, key, arr, template))
         elif _KEY_MARK + key in stored:
-            leaves.append(jax.random.wrap_key_data(stored[_KEY_MARK + key]))
+            raw = stored[_KEY_MARK + key]
+            if not (
+                hasattr(template, "dtype")
+                and jax.dtypes.issubdtype(template.dtype, jax.dtypes.prng_key)
+            ):
+                raise ValueError(
+                    f"checkpoint {path}: leaf {key!r} holds a PRNG key but the "
+                    "model expects an ordinary array"
+                )
+            t_raw_shape = tuple(np.shape(jax.random.key_data(template)))
+            if tuple(raw.shape) != t_raw_shape:
+                raise ValueError(
+                    f"checkpoint {path}: PRNG key leaf {key!r} has key-data "
+                    f"shape {tuple(raw.shape)} but the model expects "
+                    f"{t_raw_shape}"
+                )
+            leaves.append(jax.random.wrap_key_data(raw))
         else:
             raise KeyError(f"checkpoint {path} is missing leaf {key!r}")
     return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -217,7 +217,10 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
     model = load_model_for(training)
 
     criterion = nn.CrossEntropyLoss()
-    optimizer = optim.Adam(lr=training["learning_rate"])
+    optimizer = optim.Adam(
+        lr=training["learning_rate"],
+        state_dtype=training.get("optimizer_state_dtype"),
+    )
 
     # prepare() wraps model/optimizer/train loader for the mesh backend
     # (reference :129-131); test_loader deliberately stays unprepared
