@@ -135,7 +135,7 @@ def _make_runner(ddp, state_box, batch, scan):
 
 def bench_config(
     name, model, in_shape, batch_per_chip, steps, augment=None,
-    x_dtype=np.float32, scan=1,
+    x_dtype=np.float32, scan=1, opt=None,
 ):
     import jax
     import jax.numpy as jnp
@@ -145,13 +145,14 @@ def bench_config(
     from tpuddp.parallel.ddp import DistributedDataParallel
     from tpuddp.training.step import stack_batches
 
+    opt = opt or (lambda: optim.Adam(1e-3))
     devices = jax.devices()
     mesh = make_mesh(devices)
     n_chips = len(devices)
     global_batch = batch_per_chip * n_chips
 
     ddp = DistributedDataParallel(
-        model, optim.Adam(1e-3), nn.CrossEntropyLoss(), mesh=mesh,
+        model, opt(), nn.CrossEntropyLoss(), mesh=mesh,
         mode="shard_map", augment=augment,
     )
     model_in = in_shape if augment is None else augment(
@@ -217,7 +218,7 @@ def bench_config(
             # Disambiguate whole-program vs per-device module flops.
             from tpuddp.parallel import make_mesh as _mk
             ddp1 = DistributedDataParallel(
-                model, optim.Adam(1e-3), nn.CrossEntropyLoss(),
+                model, opt(), nn.CrossEntropyLoss(),
                 mesh=_mk(devices[:1]), mode="shard_map", augment=augment,
             )
             b1 = ddp1.shard((x[:batch_per_chip], y[:batch_per_chip], w[:batch_per_chip]))
@@ -401,24 +402,34 @@ def main():
             make_train_augment(size=224, compute_dtype=jnp.bfloat16),
         )
 
+    from tpuddp import optim as _optim
+
+    bf16_opt = lambda: _optim.Adam(1e-3, state_dtype="bfloat16")
     cnn_configs = [
-        # (name, factory, per-chip batch, scan K, timed steps)
+        # (name, factory, per-chip batch, scan K, timed steps, opt factory)
         ("alexnet f32 224 (per-step dispatch)",
-         lambda: (AlexNet(10), make_train_augment(size=224)), 128, 1, 30),
+         lambda: (AlexNet(10), make_train_augment(size=224)), 128, 1, 30, None),
         ("alexnet f32 224 (scan-fused)",
-         lambda: (AlexNet(10), make_train_augment(size=224)), 128, 16, 96),
-        ("alexnet bf16 224 (scan-fused)", bf16_alexnet, 128, 16, 96),
-        # the TPU-right batch: amortizes AlexNet's fixed ~1.4 GB/step of
-        # Adam + FC-weight HBM traffic (profile-backed; see BASELINE.md)
-        ("alexnet bf16 224 b512 (scan-fused)", bf16_alexnet, 512, 4, 24),
-        ("resnet18 bf16 32x32 sync-BN (scan-fused)", resnet18, 128, 16, 96),
+         lambda: (AlexNet(10), make_train_augment(size=224)), 128, 16, 96, None),
+        ("alexnet bf16 224 (scan-fused)", bf16_alexnet, 128, 16, 96, None),
+        # bf16 Adam m/v storage (training.optimizer_state_dtype): halves the
+        # optimizer-state HBM traffic that bounds AlexNet at the reference's
+        # own b128 (profile-backed; see BASELINE.md "Where the time goes")
+        ("alexnet bf16 224 bf16-opt (scan-fused)", bf16_alexnet, 128, 16, 96,
+         bf16_opt),
+        # the TPU-right batch: amortizes the remaining fixed per-step
+        # param+grad HBM traffic over 4x the samples
+        ("alexnet bf16 224 b512 bf16-opt (scan-fused)", bf16_alexnet, 512, 4,
+         24, bf16_opt),
+        ("resnet18 bf16 32x32 sync-BN (scan-fused)", resnet18, 128, 16, 96,
+         None),
     ]
-    for name, make, batch, scan, steps in cnn_configs:
+    for name, make, batch, scan, steps, opt in cnn_configs:
         try:  # diagnostics only — independent, and never break the headline line
             model, augment = make()
             bench_config(
                 name, model, (32, 32, 3), batch, steps=steps,
-                augment=augment, x_dtype=np.uint8, scan=scan,
+                augment=augment, x_dtype=np.uint8, scan=scan, opt=opt,
             )
         except Exception as e:
             log(f"{name} bench failed: {type(e).__name__}: {e}")
