@@ -179,7 +179,9 @@ def test_deferred_metrics_matches_eager(cpu_devices):
             ToyMLP(hidden=(16,)), optim.Adam(1e-2), train_loader
         )
         criterion = nn.CrossEntropyLoss()
-        augment = jax.jit(make_train_augment(size=None))
+        _aug = make_train_augment(size=None)
+        # the entrypoint's augment shape: per-batch key folded inside the jit
+        augment = jax.jit(lambda rng, i, v: _aug(jax.random.fold_in(rng, i), v))
         eval_tf = jax.jit(make_eval_transform(size=None))
         prepared_loader.set_epoch(0)
         tr = ta.train(
@@ -192,6 +194,66 @@ def test_deferred_metrics_matches_eager(cpu_devices):
     np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
     # scan fusion must be a pure batching change: identical metrics
     np.testing.assert_allclose(results[0], results[2], rtol=1e-5)
+
+
+def test_fused_evaluator_matches_eager_eval(mesh):
+    """FusedEvaluator (one scan dispatch per K batches) must reproduce the
+    facade eval loop's numbers exactly: same loss sum, correct count, and
+    total — including a padded last batch and a remainder group < K."""
+    from tpuddp.accelerate import FusedEvaluator
+    from tpuddp.data.transforms import make_eval_transform
+
+    acc = Accelerator(mesh=mesh, seed=0)
+    model = acc.prepare(ToyMLP(hidden=(16,)))
+    model.eval()
+    criterion = nn.CrossEntropyLoss()
+    transform = jax.jit(make_eval_transform(size=None))
+    ds = SyntheticClassification(n=52, shape=(8, 8, 3), seed=2)
+    loader = DataLoader(ds, batch_size=8)  # 7 batches, last one padded (w=0)
+
+    # eager oracle (the facade loop, 2+ dispatches per batch)
+    loss_sum = correct = total = 0.0
+    for x, y, w in loader:
+        outputs = model(transform(jnp.asarray(x)))
+        loss_sum += float(criterion(outputs, y, w).item())
+        pred = np.asarray(outputs.argmax(axis=-1))
+        mask = w > 0
+        correct += int(((pred == y) & mask).sum())
+        total += int(mask.sum())
+
+    ev = FusedEvaluator(model, criterion, transform=transform, fuse_steps=4)
+    for x, y, w in loader:  # 7 batches: one full flush of 4, remainder of 3
+        ev.add(x, y, w)
+    f_loss, f_correct, f_total = ev.finalize()
+    assert f_total == int(total) == 52
+    assert f_correct == int(correct)
+    np.testing.assert_allclose(f_loss, loss_sum, rtol=1e-5)
+    # evaluator is reusable: a second pass starts from zero
+    for x, y, w in loader:
+        ev.add(x, y, w)
+    f_loss2, f_correct2, f_total2 = ev.finalize()
+    assert (f_loss2, f_correct2, f_total2) == (f_loss, f_correct, f_total)
+
+
+def test_staged_upload_loader_preserves_stream(mesh):
+    """StagedUploadLoader must yield the same batches in the same order, with
+    x already a device array, and delegate set_epoch/len."""
+    from tpuddp.accelerate import StagedUploadLoader
+
+    ds = SyntheticClassification(n=40, shape=(4, 4, 3), seed=1)
+    inner = DataLoader(ds, batch_size=8, shuffle=True)
+    staged = StagedUploadLoader(inner)
+    assert len(staged) == len(inner)
+
+    staged.set_epoch(3)
+    expect = [(x.copy(), y.copy(), w.copy()) for x, y, w in inner]  # epoch 3 order
+    got = list(staged)
+    assert len(got) == len(expect)
+    for (xe, ye, we), (xg, yg, wg) in zip(expect, got):
+        assert isinstance(xg, jax.Array)
+        np.testing.assert_array_equal(np.asarray(xg), xe)
+        np.testing.assert_array_equal(yg, ye)
+        np.testing.assert_array_equal(wg, we)
 
 
 def test_superseded_backward_loss_refuses_silent_recompute(acc):

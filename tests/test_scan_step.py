@@ -17,6 +17,18 @@ from tpuddp.training.step import stack_batches
 KEY = jax.random.key(7)
 
 
+def test_resolve_scan_steps_auto_caps_by_model_size():
+    from tpuddp.training.loop import resolve_scan_steps
+
+    mb = 1024 * 1024
+    assert resolve_scan_steps("auto", 1000) == 8  # unknown size: conservative
+    assert resolve_scan_steps("auto", 1000, param_bytes=100 * mb) == 8
+    # dispatch-bound small models get the deep cap (BASELINE.md K-sweep)
+    assert resolve_scan_steps("auto", 1000, param_bytes=2 * mb) == 64
+    assert resolve_scan_steps("auto", 5, param_bytes=2 * mb) == 5  # epoch-bound
+    assert resolve_scan_steps(16, 1000, param_bytes=2 * mb) == 16  # explicit wins
+
+
 def make_batches(k, n=32, shape=(8, 8, 3), seed=0):
     ds = SyntheticClassification(n=n * k, shape=shape, seed=seed)
     return [

@@ -127,14 +127,16 @@ class Adam(Optimizer):
 
     ``state_dtype`` (e.g. ``jnp.bfloat16`` or ``"bfloat16"``) stores m/v in
     that dtype while keeping params full-precision masters. The moment math
-    itself always runs in the gradient dtype — stored moments are upcast on
-    read and stochastically rounded on write (deterministically keyed off
-    the step counter, so runs stay reproducible). On TPU this halves the
+    itself ALWAYS runs in f32 — stored moments are upcast on read and
+    stochastically rounded on write (deterministically keyed off the step
+    counter, so runs stay reproducible). On TPU this halves the
     optimizer-state HBM traffic, which profiling showed is the dominant cost
     of the fused weight-grad+update bucket for FC-heavy models (BASELINE.md
     "Where the time goes"); XLA fuses casts and rounding into the update
     kernel so no extra memory passes are materialized.
-    Default ``None`` keeps moments in the params' own dtype (torch parity).
+    Default ``None`` stores moments in f32 regardless of param/grad dtype:
+    sub-f32 EMA storage without stochastic rounding would freeze v (see
+    :func:`_stochastic_round_bf16`).
     """
 
     def __init__(
@@ -171,7 +173,12 @@ class Adam(Optimizer):
             self.state_dtype = dt
 
     def init(self, params):
-        zeros = lambda p: jnp.zeros_like(p, dtype=self.state_dtype)
+        # moments default to f32 storage even for low-precision params: the
+        # EMA math must never run below f32 (sub-ulp decrements vanish — see
+        # _stochastic_round_bf16), and the storage dtype must match what
+        # update() returns so scan carries stay shape/dtype-stable
+        dt = self.state_dtype or jnp.float32
+        zeros = lambda p: jnp.zeros_like(p, dtype=dt)
         return AdamState(
             step=jnp.zeros((), jnp.int32),
             m=tmap(zeros, params),
@@ -183,12 +190,15 @@ class Adam(Optimizer):
             grads = tmap(lambda g, p: g + self.weight_decay * p, grads, params)
         step = opt_state.step + 1
         b1, b2 = self.b1, self.b2
+        # EMA math in f32 regardless of grad/param/storage dtype (bf16 math
+        # would freeze v: its 0.1% decrement is below bf16's half-ulp)
+        f32 = jnp.float32
         m = tmap(
-            lambda m_, g: b1 * m_.astype(g.dtype) + (1 - b1) * g,
+            lambda m_, g: b1 * m_.astype(f32) + (1 - b1) * g.astype(f32),
             opt_state.m, grads,
         )
         v = tmap(
-            lambda v_, g: b2 * v_.astype(g.dtype) + (1 - b2) * jnp.square(g),
+            lambda v_, g: b2 * v_.astype(f32) + (1 - b2) * jnp.square(g.astype(f32)),
             opt_state.v, grads,
         )
         t = step.astype(jnp.float32)
@@ -196,12 +206,12 @@ class Adam(Optimizer):
         bc2 = 1 - jnp.power(b2, t)
         new_params = tmap(
             lambda p, m_, v_: p
-            - self.lr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.eps),
+            - (self.lr * (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.eps)).astype(p.dtype),
             params,
             m,
             v,
         )
-        if self.state_dtype is not None:
+        if self.state_dtype == jnp.bfloat16:
             m = _cast_state_tree(m, self.state_dtype, step, 0x5ADA0000)
             v = _cast_state_tree(v, self.state_dtype, step, 0x7EE70000)
         return new_params, AdamState(step=step, m=m, v=v)

@@ -22,6 +22,7 @@ import time
 from typing import Optional
 
 import jax
+import numpy as np
 
 from tpuddp import seeding
 from tpuddp.parallel import collectives as col
@@ -37,20 +38,40 @@ from tpuddp.utils.observability import (
 logger = logging.getLogger("tpuddp")
 
 
-def resolve_scan_steps(scan_steps, n_batches: int) -> int:
+_AUTO_SCAN_CAP = 8
+_AUTO_SCAN_CAP_SMALL = 64  # dispatch-bound models: see resolve_scan_steps
+_SMALL_PARAM_BYTES = 4 * 1024 * 1024
+
+
+def resolve_scan_steps(scan_steps, n_batches: int, param_bytes=None) -> int:
     """Resolve the per-dispatch fusion factor K.
 
     ``"auto"`` (the default) fuses up to 8 batches per dispatch when the
     epoch has at least that many — the measured per-dispatch runtime latency
     dominates per-step time otherwise (BASELINE.md: ~7x on the toy model
-    through a tunneled TPU). Any integer pins K explicitly; 1 disables
-    fusion (one dispatch per batch, the reference's cadence)."""
+    through a tunneled TPU). For *small* models (whole parameter set under
+    ~4 MB, when ``param_bytes`` is known) the cap is 64: their step compute
+    is so short that dispatch latency still dominates at K=8, and throughput
+    keeps scaling nearly linearly with K (the bench's toy-MLP K-sweep,
+    BASELINE.md). Any integer pins K explicitly; 1 disables fusion (one
+    dispatch per batch, the reference's cadence)."""
     if scan_steps in (None, "auto"):
-        return max(1, min(8, n_batches))
+        cap = _AUTO_SCAN_CAP
+        if param_bytes is not None and param_bytes < _SMALL_PARAM_BYTES:
+            cap = _AUTO_SCAN_CAP_SMALL
+        return max(1, min(cap, n_batches))
     k = int(scan_steps)
     if k < 1:
         raise ValueError(f"scan_steps must be >= 1 or 'auto', got {scan_steps!r}")
     return k
+
+
+def _param_bytes(params) -> int:
+    return sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in jax.tree_util.tree_leaves(params)
+        if hasattr(l, "shape") and hasattr(l, "dtype")
+    )
 
 
 def _fused_pass(
@@ -112,12 +133,13 @@ def run_training_loop(
     batches (ShardedDataLoader for DP; see tpuddp.data.loader).
     """
     is_main = jax.process_index() == 0
+    pbytes = _param_bytes(state.params) if hasattr(state, "params") else None
     eval_scan_steps = (
-        resolve_scan_steps(scan_steps, len(test_loader))
+        resolve_scan_steps(scan_steps, len(test_loader), pbytes)
         if hasattr(ddp, "eval_step_many")
         else 1
     )
-    scan_steps = resolve_scan_steps(scan_steps, len(train_loader))
+    scan_steps = resolve_scan_steps(scan_steps, len(train_loader), pbytes)
     history = []
     metrics_writer = MetricsWriter(save_dir)
     profiling = maybe_start_profiler(save_dir)  # $TPUDDP_PROFILE hook

@@ -52,14 +52,18 @@ def train(
     model.train()
     running_loss = 0.0
     batch_losses = []
-    for inputs, labels, weights in train_loader:
+    # ONE fresh key per epoch; the per-batch key is fold_in(base, i) INSIDE
+    # the jitted augment — an eager split per batch would be a device
+    # dispatch of its own (measured ~3 ms on tunneled runtimes)
+    aug_base = accelerator.next_rng_key()
+    for i, (inputs, labels, weights) in enumerate(train_loader):
         # no .to(device): placement is the backend's job (reference :44 note)
         optimizer.zero_grad()
 
         # Flip-augmented inputs (reference transform_train includes
         # RandomHorizontalFlip, data_and_toy_model.py:14-19), keyed off the
         # accelerator's per-process PRNG stream.
-        x = augment(accelerator.next_rng_key(), jnp.asarray(inputs))
+        x = augment(aug_base, i, jnp.asarray(inputs))
 
         # model(...) and criterion(...) record lazily; accelerator.backward
         # runs them as ONE jitted value_and_grad over the sharded global batch,
@@ -100,41 +104,37 @@ def transform_host(transform, inputs):
 
 def evaluate(model, test_loader, criterion, device, transform, deferred=False):
     model.eval()
+    if deferred:
+        # scan-fused eval: transform + forward + loss + metric accumulation
+        # for K batches per jit dispatch, one host fetch at the end — the
+        # managed analog of the native build_eval_scan_step (same quirk-Q3
+        # semantics: full test stream on every process, per-batch-mean loss).
+        # ONE evaluator per (model, criterion, transform), cached on the
+        # model: a fresh instance per epoch would retrace its scan program
+        # every epoch.
+        from tpuddp.accelerate import FusedEvaluator
+
+        ev = getattr(model, "_tpuddp_fused_eval", None)
+        if ev is None or ev.criterion is not criterion or ev.transform is not transform:
+            ev = FusedEvaluator(model, criterion, transform=transform)
+            model._tpuddp_fused_eval = ev
+        for inputs, labels, weights in test_loader:
+            ev.add(inputs, labels, weights)
+        test_loss, correct, total = ev.finalize()
+        accuracy = 100 * correct / total
+        return test_loss / len(test_loader), accuracy
     correct = 0
     total = 0
     test_loss = 0.0
-    device_stats = None
     for inputs, labels, weights in test_loader:
         inputs = transform_host(transform, inputs)
         outputs = model(inputs)
         loss = criterion(outputs, labels, weights)
-        if deferred:
-            # accumulate (loss, n_correct, n) as device scalars; one transfer
-            # at epoch end instead of three syncs per batch. Scalar-add chains
-            # reuse one cached program regardless of epoch length.
-            predicted = outputs.argmax(axis=-1)
-            labels_d = jnp.asarray(labels)
-            mask_d = jnp.asarray(weights) > 0
-            stat = (
-                loss.device_value(),
-                ((predicted == labels_d) & mask_d).sum(),
-                mask_d.sum(),
-            )
-            device_stats = (
-                stat
-                if device_stats is None
-                else tuple(a + b for a, b in zip(device_stats, stat))
-            )
-        else:
-            test_loss += loss.item()
-            predicted = np.asarray(outputs.argmax(axis=-1))
-            mask = weights > 0
-            total += int(mask.sum())
-            correct += int(((predicted == labels) & mask).sum())
-    if deferred:
-        # one fetch for the three accumulated device scalars
-        sums = jax.device_get(device_stats)
-        test_loss, correct, total = float(sums[0]), int(sums[1]), int(sums[2])
+        test_loss += loss.item()
+        predicted = np.asarray(outputs.argmax(axis=-1))
+        mask = weights > 0
+        total += int(mask.sum())
+        correct += int(((predicted == labels) & mask).sum())
     accuracy = 100 * correct / total
     return test_loss / len(test_loader), accuracy
 
@@ -229,19 +229,30 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         model, optimizer, train_loader
     )
 
+    if training.get("prefetch", True):
+        from tpuddp.accelerate import StagedUploadLoader
+        from tpuddp.data import PrefetchLoader
+
+        # host batch assembly overlaps device compute (PrefetchLoader, the
+        # reference's num_workers analog) and batch N+1's host->device upload
+        # is issued while batch N's step runs (StagedUploadLoader)
+        training_dataloader = StagedUploadLoader(PrefetchLoader(training_dataloader))
+        test_loader = StagedUploadLoader(PrefetchLoader(test_loader))
+
     # jitted so each runs as one fused device op, not eager op-by-op;
     # normalization stats follow the dataset, flip is a config knob
     mean, std = norm_stats_for(training)
     cdtype = compute_dtype_for(training)
-    augment = jax.jit(
-        make_train_augment(
-            size=training.get("image_size"),
-            flip=flip_for(training),
-            mean=mean,
-            std=std,
-            compute_dtype=cdtype,
-        )
+    _aug = make_train_augment(
+        size=training.get("image_size"),
+        flip=flip_for(training),
+        mean=mean,
+        std=std,
+        compute_dtype=cdtype,
     )
+    # (base_key, batch_index, x): the per-batch key derivation happens inside
+    # the jit (see train()'s aug_base note)
+    augment = jax.jit(lambda rng, i, x: _aug(jax.random.fold_in(rng, i), x))
     eval_transform = jax.jit(
         make_eval_transform(
             size=training.get("image_size"), mean=mean, std=std,
