@@ -290,3 +290,54 @@ def test_batchnorm_all_padded_batch_leaves_running_stats():
     )
     np.testing.assert_array_equal(np.asarray(new_state["mean"]), np.full(3, 2.0))
     np.testing.assert_array_equal(np.asarray(new_state["var"]), np.full(3, 3.0))
+
+
+def test_divergent_state_protocol():
+    """sync_buffers='none' validation holds by construction (Module.
+    divergent_state): an UNDECLARED custom stateful leaf counts as divergent;
+    declaring divergent_state() -> False vouches replica-invariance."""
+    from tpuddp.nn.core import Module
+    from tpuddp.nn.norm import has_divergent_buffers
+
+    class Counter(Module):
+        def init(self, key, x):
+            return (), {"count": jnp.zeros(())}
+
+        def apply(self, params, state, x, ctx):
+            return x, {"count": state["count"] + 1.0}
+
+    class InvariantCounter(Counter):
+        def divergent_state(self):
+            return False
+
+    assert has_divergent_buffers(Counter())
+    assert not has_divergent_buffers(InvariantCounter())
+    assert has_divergent_buffers(nn.Sequential(nn.Linear(4), Counter()))
+    assert not has_divergent_buffers(nn.Sequential(nn.Linear(4), InvariantCounter()))
+
+    class StatefulContainer(Module):
+        """Container with its OWN buffer beside clean children — must not
+        escape the check just because its children are fine."""
+
+        def __init__(self):
+            self.inner = nn.Linear(4)
+
+        def children(self):
+            return (self.inner,)
+
+        def init(self, key, x):
+            p, s = self.inner.init(key, x)
+            return {"inner": p}, {"inner": s, "ema": jnp.zeros(x.shape[-1])}
+
+        def apply(self, params, state, x, ctx):
+            y, s = self.inner.apply(params["inner"], state["inner"], x, ctx)
+            new = dict(state, inner=s, ema=0.9 * state["ema"])
+            return y, new
+
+    assert has_divergent_buffers(StatefulContainer())  # undeclared own init
+    assert not has_divergent_buffers(nn.Sequential(nn.Linear(4)))  # declared container
+    # the built-in declarations
+    assert has_divergent_buffers(nn.BatchNorm())
+    assert not has_divergent_buffers(nn.BatchNorm(sync=True))
+    assert not has_divergent_buffers(nn.BatchNorm(track_running_stats=False))
+    assert not has_divergent_buffers(nn.Sequential(nn.Conv2d(4, 3), nn.ReLU()))

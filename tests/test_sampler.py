@@ -98,3 +98,36 @@ def test_len_protocol_accepts_dataset_object():
 
     s = DistributedSampler(DS(), 4, 0, shuffle=False)
     assert len(s) == 3
+
+
+def test_order_source_replaces_permutation_keeps_discipline():
+    """order_source (the mechanism behind preserving a user sampler in
+    Accelerator.prepare) replaces the seeded permutation while the pad-by-wrap
+    and strided-disjoint-shard rules stay authoritative here."""
+    order = [5, 3, 8, 1, 0, 7, 2]  # deliberate custom order, len 7
+    shards = [
+        list(DistributedSampler(10, 4, r, order_source=order)) for r in range(4)
+    ]
+    # pad-by-wrap to 8: [5, 3, 8, 1, 0, 7, 2, 5]; rank r takes order[r::4]
+    assert shards == [[5, 0], [3, 7], [8, 2], [1, 5]]
+    # sizes derive from the order's length (a subset), not the dataset's
+    assert DistributedSampler(10, 4, 0, order_source=order).num_samples == 2
+    assert DistributedSampler(10, 4, 0, order_source=order, drop_last=True).num_samples == 1
+
+
+def test_order_source_length_change_raises():
+    class Shrinking:
+        def __init__(self):
+            self.n = 6
+
+        def __len__(self):
+            return self.n
+
+        def __iter__(self):
+            return iter(range(self.n))
+
+    src = Shrinking()
+    s = DistributedSampler(10, 2, 0, order_source=src)
+    src.n = 4  # sampler sized for 6; producing 4 must fail loudly
+    with pytest.raises(ValueError, match="declared len"):
+        s.local_indices()

@@ -165,3 +165,40 @@ def test_pmean_buffer_sync_averages_divergent_stats(cpu_devices):
     # published state is replicated and finite
     bn_state = jax.tree_util.tree_leaves(state.model_state)
     assert all(np.all(np.isfinite(np.asarray(leaf))) for leaf in bn_state)
+
+
+def test_undeclared_stateful_module_refused_at_wrap_time(cpu_devices):
+    """A future custom stateful layer that never declares divergent_state()
+    must be refused under sync_buffers='none' — the by-construction guarantee
+    that replaced the old isinstance(BatchNorm) check."""
+    from tpuddp import nn
+    from tpuddp.nn.core import Module
+
+    mesh = make_mesh(cpu_devices)
+
+    class EmaTracker(Module):
+        """Stateful, unsynced, and NOT special-cased anywhere."""
+
+        def init(self, key, x):
+            return (), {"ema": jnp.zeros(x.shape[-1])}
+
+        def apply(self, params, state, x, ctx):
+            new = {"ema": 0.9 * state["ema"] + 0.1 * x.mean(axis=tuple(range(x.ndim - 1)))}
+            return x, new
+
+    model = nn.Sequential(nn.Flatten(), EmaTracker(), nn.Linear(10))
+    with pytest.raises(ValueError, match="divergent_state"):
+        DistributedDataParallel(
+            model, optim.Adam(1e-2), CrossEntropyLoss(),
+            mesh=mesh, mode="shard_map", sync_buffers="none",
+        )
+
+    class VouchedEmaTracker(EmaTracker):
+        def divergent_state(self):
+            return False  # (for the test; a real EMA would sync instead)
+
+    model2 = nn.Sequential(nn.Flatten(), VouchedEmaTracker(), nn.Linear(10))
+    DistributedDataParallel(
+        model2, optim.Adam(1e-2), CrossEntropyLoss(),
+        mesh=mesh, mode="shard_map", sync_buffers="none",
+    )

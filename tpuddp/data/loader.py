@@ -137,6 +137,52 @@ class DataLoader:
             yield _fetch_padded(self.dataset, chunk, self.batch_size)
 
 
+class _EpochMemoizedOrder:
+    """Materializes a user sampler's order ONCE per epoch and serves the same
+    array to every replica's :class:`DistributedSampler`. Required for
+    correctness, not just speed: a non-deterministic sampler (e.g. a weighted
+    random sampler that doesn't key off the epoch) iterated independently per
+    replica — or drawn independently per PROCESS in a multi-host world —
+    would give replicas DIFFERENT base orders and silently break shard
+    disjointness. Locally the cache guarantees one materialization; across
+    processes, process 0's order is broadcast so every host shards the same
+    order. The cache invalidates on ``set_epoch`` (the per-epoch contract
+    every tpuddp epoch driver honors)."""
+
+    def __init__(self, sampler):
+        self.sampler = sampler
+        self._cache: Optional[np.ndarray] = None
+
+    def set_epoch(self, epoch: int) -> None:
+        set_ep = getattr(self.sampler, "set_epoch", None)
+        if set_ep is not None:
+            set_ep(epoch)
+        self._cache = None
+
+    def __len__(self) -> int:
+        return len(self.sampler)
+
+    def _materialize(self) -> np.ndarray:
+        if self._cache is None:
+            arr = np.fromiter(iter(self.sampler), dtype=np.int64)
+            if jax.process_count() > 1:
+                from tpuddp.parallel import collectives as col
+
+                arr = np.asarray(col.broadcast_one_to_all(arr), dtype=np.int64)
+            self._cache = arr
+        return self._cache
+
+    def __array__(self, dtype=None):
+        # DistributedSampler._global_indices takes this fast path: the cached
+        # ndarray is handed over directly instead of being re-iterated
+        # element-by-element once per local replica
+        arr = self._materialize()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+
 class ShardedDataLoader:
     """Global-batch DP loader: one instance per process, one sampler per local
     replica. Yields the process-local ``(x, y, w)`` slice of the global batch
@@ -152,6 +198,7 @@ class ShardedDataLoader:
         shuffle: bool = True,
         seed: int = 0,
         drop_last: bool = False,
+        sampler=None,
     ):
         self.dataset = dataset
         self.batch_size = batch_size  # per replica
@@ -166,6 +213,14 @@ class ShardedDataLoader:
         self.local_ranks = [
             rank for rank, d in enumerate(flat_devices) if d.process_index == proc
         ]
+        # base_sampler: a user-supplied full-dataset order source (iter + len
+        # + optional set_epoch). Its order is PRESERVED and sharded around:
+        # it feeds the per-replica DistributedSamplers as their order_source,
+        # so the pad-by-wrap/stride discipline stays the ONE authoritative
+        # implementation (parallel/sampler.py) — HF prepare() semantics: a
+        # custom sampler rides inside the sharded sampler, it is not replaced.
+        self.base_sampler = sampler
+        self._order = _EpochMemoizedOrder(sampler) if sampler is not None else None
         self.samplers = [
             DistributedSampler(
                 len(dataset),
@@ -173,13 +228,17 @@ class ShardedDataLoader:
                 rank=rank,
                 shuffle=shuffle,
                 seed=seed,
+                order_source=self._order,
             )
             for rank in self.local_ranks
         ]
 
     def set_epoch(self, epoch: int) -> None:
         """Fan set_epoch to every local replica's sampler (reference
-        multi-GPU-training-torch.py:175-178)."""
+        multi-GPU-training-torch.py:175-178) — and to the user sampler, via
+        the epoch memo, when one was supplied."""
+        if self._order is not None:
+            self._order.set_epoch(epoch)
         for s in self.samplers:
             s.set_epoch(epoch)
 

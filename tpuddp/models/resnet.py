@@ -27,6 +27,9 @@ class BasicBlock(Module):
     def children(self):
         return (self.conv1, self.bn1, self.conv2, self.bn2, self.down_conv, self.down_bn)
 
+    def divergent_state(self) -> bool:
+        return False  # aggregates child state only; owns no buffers of its own
+
     def init(self, key, x):
         keys = jax.random.split(key, 6)
         in_ch = x.shape[-1]

@@ -79,6 +79,30 @@ class Module:
     def children(self):
         return ()
 
+    def divergent_state(self) -> Optional[bool]:
+        """Whether THIS module's own buffers can diverge across replicas under
+        data parallelism (per-replica batch statistics, counters, ...) — the
+        protocol behind ``sync_buffers="none"`` validation
+        (tpuddp/nn/norm.py:has_divergent_buffers).
+
+        Three-valued by design so the validation holds BY CONSTRUCTION:
+
+        The declaration covers the module's OWN buffers only — children are
+        always walked separately by the checker:
+
+        - ``True``  — diverges (BatchNorm with unsynced running stats);
+        - ``False`` — the module vouches its own state is replica-invariant
+          (or that it has none beyond its children's); variable-creating
+          modules must declare this explicitly (Linear, Conv2d, Sequential,
+          BasicBlock do);
+        - ``None``  (this default) — undeclared. Any module that creates
+          variables (overrides ``init``) but never declared its divergence is
+          treated as divergent: a future stateful layer cannot silently slip
+          past ``sync_buffers="none"`` validation by being forgotten.
+          Modules that don't override ``init`` are stateless by construction.
+        """
+        return None
+
 
 class Sequential(Module):
     """Composes modules in order; params/state are tuples over children."""
@@ -106,6 +130,9 @@ class Sequential(Module):
 
     def children(self):
         return self.layers
+
+    def divergent_state(self) -> bool:
+        return False  # composes children only; owns no buffers of its own
 
     def __getitem__(self, i):
         return self.layers[i]

@@ -55,6 +55,9 @@ class Linear(Module):
             y = y + params["bias"].astype(x.dtype)
         return y, state
 
+    def divergent_state(self) -> bool:
+        return False  # parameters only, no buffers
+
 
 class Conv2d(Module):
     """2-D convolution, NHWC / HWIO. ``padding`` is 'SAME', 'VALID', or an int
@@ -112,6 +115,9 @@ class Conv2d(Module):
         if self.use_bias:
             y = y + params["bias"].astype(y.dtype)
         return y, state
+
+    def divergent_state(self) -> bool:
+        return False  # parameters only, no buffers
 
 
 class _Pool2d(Module):

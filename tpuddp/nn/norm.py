@@ -152,16 +152,32 @@ class BatchNorm(Module):
             y = y * params["scale"] + params["bias"]
         return y.astype(x.dtype), new_state
 
+    def divergent_state(self) -> bool:
+        # running statistics accumulate the LOCAL shard's batches unless
+        # cross-replica synced — the canonical divergent buffer
+        return self.track_running_stats and not self.sync
+
 
 def has_divergent_buffers(module: Module) -> bool:
     """True when the module tree contains a buffer that *diverges across
-    replicas* under data parallelism: a stateful (``track_running_stats``)
-    BatchNorm whose statistics are not cross-replica synced. Used by the DDP
-    step builder to refuse ``sync_buffers="none"`` configs that would publish
-    per-replica-divergent buffers as replicated."""
-    if isinstance(module, BatchNorm):
-        if module.track_running_stats and not module.sync:
-            return True
+    replicas* under data parallelism. Used by the DDP step builder to refuse
+    ``sync_buffers="none"`` configs that would publish per-replica-divergent
+    buffers as replicated.
+
+    The judgment is the :meth:`Module.divergent_state` protocol, so it holds
+    by construction: ``divergent_state`` speaks for a module's OWN buffers
+    (children are always walked separately), and ANY module that creates
+    variables (overrides ``init``) — leaf or container — without declaring
+    its divergence is conservatively treated as divergent. A future stateful
+    layer cannot silently bypass the validation by not being special-cased
+    here; built-in variable-creating modules (Linear, Conv2d, Sequential,
+    BasicBlock, BatchNorm) all declare."""
+    own = module.divergent_state()
+    if own:
+        return True
+    if own is None and type(module).init is not Module.init:
+        # undeclared variable-creating module: could hold divergent state
+        return True
     return any(has_divergent_buffers(c) for c in module.children())
 
 

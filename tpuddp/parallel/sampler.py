@@ -40,7 +40,14 @@ class DistributedSampler:
         shuffle: bool = True,
         seed: int = 0,
         drop_last: bool = False,
+        order_source=None,
     ):
+        """``order_source``: optional externally-supplied base order (an
+        iterable of dataset indices with ``len``) that REPLACES the seeded
+        permutation while keeping this class's pad/drop_last/stride discipline
+        authoritative — the mechanism behind preserving a user sampler's order
+        in ``Accelerator.prepare`` (HF semantics: the custom sampler rides
+        inside the sharded sampler). ``shuffle`` is ignored when set."""
         if num_replicas is None or rank is None:
             raise ValueError("num_replicas and rank are required")
         if not (0 <= rank < num_replicas):
@@ -51,12 +58,17 @@ class DistributedSampler:
         self.shuffle = bool(shuffle)
         self.seed = int(seed)
         self.drop_last = bool(drop_last)
+        self.order_source = order_source
         self.epoch = 0
 
-        if self.drop_last and self.dataset_len % self.num_replicas != 0:
-            self.num_samples = self.dataset_len // self.num_replicas
+        # sizes derive from the order's length when one is supplied (it may
+        # be a subset of the dataset), else from the dataset length
+        base_len = self.dataset_len if order_source is None else len(order_source)
+        self._base_len = base_len
+        if self.drop_last and base_len % self.num_replicas != 0:
+            self.num_samples = base_len // self.num_replicas
         else:
-            self.num_samples = math.ceil(self.dataset_len / self.num_replicas)
+            self.num_samples = math.ceil(base_len / self.num_replicas)
         self.total_size = self.num_samples * self.num_replicas
 
     def set_epoch(self, epoch: int) -> None:
@@ -66,7 +78,21 @@ class DistributedSampler:
         self.epoch = int(epoch)
 
     def _global_indices(self) -> np.ndarray:
-        if self.shuffle:
+        if self.order_source is not None:
+            src = self.order_source
+            if hasattr(src, "__array__"):
+                # array-backed source (e.g. the loader's epoch memo): take
+                # the ndarray directly, no per-element re-iteration
+                indices = np.asarray(src, dtype=np.int64)
+            else:
+                indices = np.fromiter(iter(src), dtype=np.int64)
+            if len(indices) != self._base_len:
+                raise ValueError(
+                    f"order_source produced {len(indices)} indices but "
+                    f"declared len {self._base_len}; shard sizes were computed "
+                    "from the declared length"
+                )
+        elif self.shuffle:
             rng = np.random.Generator(np.random.PCG64(self.seed + self.epoch))
             indices = rng.permutation(self.dataset_len)
         else:

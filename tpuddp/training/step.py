@@ -70,11 +70,15 @@ def _validate_sync_buffers(model, axis_name: Optional[str], sync_buffers: str):
 
         if has_divergent_buffers(model):
             raise ValueError(
-                'sync_buffers="none" with an unsynced stateful BatchNorm: '
-                "per-replica running statistics would diverge but be "
-                "published as replicated state. Use sync_buffers='broadcast' "
-                "(torch DDP's broadcast_buffers=True default), 'pmean', or "
-                "convert_sync_batchnorm(model)."
+                'sync_buffers="none" with a module whose buffers diverge '
+                "across replicas (an unsynced stateful BatchNorm, or a "
+                "custom stateful layer that does not declare "
+                "divergent_state()): per-replica state would diverge but be "
+                "published as replicated. Use sync_buffers='broadcast' "
+                "(torch DDP's broadcast_buffers=True default), 'pmean', "
+                "convert_sync_batchnorm(model), or declare "
+                "divergent_state() -> False on the module if its state is "
+                "replica-invariant."
             )
 
 
