@@ -602,3 +602,255 @@ def test_load_model_clears_stale_accumulation(acc_accum_factory=None):
             )
         )
         assert moved
+
+
+def test_restore_discards_queued_steps_without_executing(mesh, tmp_path):
+    """load_model/load_state must DROP fused steps queued against the
+    pre-restore weights — not execute them (a wasted dispatch whose updates
+    the restore overwrites). The queued losses' reads then fail loudly."""
+    acc = Accelerator(mesh=mesh, seed=11, fuse_steps=4)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(0.5))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+    model(x)
+    acc.save_model(model, str(tmp_path))
+    saved = jax.tree_util.tree_map(np.asarray, model.params)
+
+    losses = []
+    for _ in range(2):  # queued, below fuse_steps=4
+        loss = criterion(model(x), y)
+        acc.backward(loss)
+        opt.step()
+        losses.append(loss)
+    assert len(opt._queue) == 2
+    # a dispatch during the restore would be a bug: make it fail loudly
+    opt._dispatch_flush = lambda q: (_ for _ in ()).throw(
+        AssertionError("queued steps must be discarded, not executed")
+    )
+    acc.load_model(model, str(tmp_path))
+    assert opt._queue == []
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        model.params, saved,
+    )
+    for l in losses:
+        with pytest.raises(RuntimeError, match="discarded"):
+            l.item()
+
+
+def test_load_model_resets_optimizer_moments(acc, tmp_path):
+    """save_model is weights-only: after load_model, Adam moments computed
+    against the pre-restore weights must NOT steer updates to the restored
+    ones — the optimizer state resets and re-inits on the next step."""
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.Adam(1e-2))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+    model(x)
+    acc.save_model(model, str(tmp_path))
+    for _ in range(2):
+        loss = criterion(model(x), y)
+        acc.backward(loss)
+        opt.step()
+    assert opt.opt_state is not None
+    acc.load_model(model, str(tmp_path))
+    assert opt.opt_state is None  # stale moments discarded
+    loss = criterion(model(x), y)
+    acc.backward(loss)
+    opt.step()  # re-inits from zero moments
+    assert int(np.asarray(opt.opt_state.step)) == 1
+
+
+def test_sum_losses_empty_returns_zero():
+    from tpuddp.accelerate import sum_losses
+
+    assert float(sum_losses([])) == 0.0
+
+
+def _kill_and_resume_leg(mesh, tmp_path, resume: bool):
+    """One 'process lifetime' of the managed kill-and-resume scenario: fresh
+    Accelerator/model/optimizer (what a restarted process has), optional
+    load_state, then two deterministic train steps."""
+    ds = SyntheticClassification(n=32, shape=(4, 4, 3), seed=13)
+    x, y = ds.get_batch(np.arange(16))
+    w = np.ones(16, np.float32)
+    acc = Accelerator(mesh=mesh, seed=21)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.Adam(1e-2))
+    criterion = nn.CrossEntropyLoss()
+    model(x)  # lazy init: creates the structure load_state needs
+    if resume:
+        start = acc.load_state(model, opt, str(tmp_path))
+        assert start == 4  # saved with epoch=3
+    for _ in range(2):
+        loss = criterion(model(x), y, w)
+        acc.backward(loss)
+        opt.step()
+    return acc, model, opt
+
+
+def test_save_state_load_state_lossless_resume(mesh, tmp_path):
+    """The managed kill-and-resume contract (native analog: restore_latest on
+    the full TrainState): a run that dies after save_state and restarts with
+    load_state must continue BIT-EXACTLY like the run that never died —
+    weights, Adam moments, and the RNG stream all restored."""
+    ds = SyntheticClassification(n=32, shape=(4, 4, 3), seed=13)
+    x, y = ds.get_batch(np.arange(16))
+    w = np.ones(16, np.float32)
+
+    # continuous run: 3 steps, save full state, 2 more steps
+    acc = Accelerator(mesh=mesh, seed=21)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.Adam(1e-2))
+    criterion = nn.CrossEntropyLoss()
+    for _ in range(3):
+        loss = criterion(model(x), y, w)
+        acc.backward(loss)
+        opt.step()
+    acc.save_state(model, opt, str(tmp_path), epoch=3)
+    assert os.path.exists(tmp_path / "state_3.npz")
+    for _ in range(2):
+        loss = criterion(model(x), y, w)
+        acc.backward(loss)
+        opt.step()
+    expect_params = jax.tree_util.tree_map(np.asarray, model.params)
+    expect_m = jax.tree_util.tree_map(np.asarray, opt.opt_state.m)
+
+    # killed + restarted run: fresh everything, load_state, same 2 steps
+    _, model2, opt2 = _kill_and_resume_leg(mesh, tmp_path, resume=True)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        model2.params, expect_params,
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        opt2.opt_state.m, expect_m,
+    )
+    assert int(np.asarray(opt2.opt_state.step)) == 5
+
+    # without resume, the fresh run diverges (proves the restore did the work)
+    _, model3, _ = _kill_and_resume_leg(mesh, str(tmp_path / "nope"), resume=False)
+    diverged = any(
+        bool(np.any(np.asarray(a) != b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(model3.params),
+            jax.tree_util.tree_leaves(expect_params),
+        )
+    )
+    assert diverged
+
+
+def test_load_state_empty_dir_is_fresh_start(mesh, tmp_path):
+    acc = Accelerator(mesh=mesh, seed=0)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.Adam(1e-2))
+    model(np.zeros((8, 4, 4, 3), np.float32))
+    assert acc.load_state(model, opt, str(tmp_path / "none")) == 0
+
+
+def test_save_state_rejects_mid_accumulation_cycle(mesh, tmp_path):
+    acc = Accelerator(mesh=mesh, seed=1, gradient_accumulation_steps=4)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(0.1))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+    loss = criterion(model(x), y)
+    acc.backward(loss)
+    opt.step()  # 1 of 4: mid-cycle
+    with pytest.raises(RuntimeError, match="accumulation"):
+        acc.save_state(model, opt, str(tmp_path))
+    opt.flush_accumulation()
+    acc.save_state(model, opt, str(tmp_path))  # boundary: fine
+
+
+def test_state_dtype_mismatch_names_the_leaf(mesh, tmp_path):
+    """Restoring bf16-moment state into an f32-state run must fail with the
+    optimizer_state_dtype hint, not load garbage."""
+    import jax.numpy as jnp
+
+    acc = Accelerator(mesh=mesh, seed=2)
+    model, opt = acc.prepare(
+        ToyMLP(hidden=(8,)), optim.Adam(1e-2, state_dtype=jnp.bfloat16)
+    )
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+    loss = criterion(model(x), y)
+    acc.backward(loss)
+    opt.step()
+    acc.save_state(model, opt, str(tmp_path))
+
+    acc2 = Accelerator(mesh=mesh, seed=2)
+    model2, opt2 = acc2.prepare(ToyMLP(hidden=(8,)), optim.Adam(1e-2))
+    model2(x)
+    with pytest.raises(ValueError, match="optimizer_state_dtype"):
+        acc2.load_state(model2, opt2, str(tmp_path))
+
+
+class _SequentialSampler:
+    """A deliberate, custom ordering (reversed indices) with the sampler
+    protocol — prepare() must preserve it, not silently reshuffle."""
+
+    def __init__(self, n):
+        self.n = n
+        self.epoch = 0
+
+    def __iter__(self):
+        return iter(range(self.n - 1, -1, -1))
+
+    def __len__(self):
+        return self.n
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+
+def test_prepare_preserves_custom_sampler_order(acc):
+    """HF contract: a user sampler rides inside the sharded batch sampler.
+    The prepared loader must yield batches derived from the SAMPLER's order
+    (strided across replicas, DistributedSampler-style), not a reshuffle."""
+    ds = SyntheticClassification(n=32, shape=(4, 4, 3), seed=3)
+    sampler = _SequentialSampler(32)
+    loader = DataLoader(ds, batch_size=2, sampler=sampler)
+    prepared = acc.prepare(loader)
+    assert prepared.base_sampler is sampler
+    prepared.set_epoch(5)
+    assert sampler.epoch == 5  # set_epoch reaches the user sampler
+
+    order = np.arange(31, -1, -1)
+    world = 8
+    batches = list(prepared)
+    assert len(batches) == 2  # 32 / 8 replicas / batch 2
+    for s, (xb, yb, wb) in enumerate(batches):
+        expect_idx = np.concatenate(
+            [order[r::world][s * 2 : (s + 1) * 2] for r in range(world)]
+        )
+        ex, ey = ds.get_batch(expect_idx)
+        np.testing.assert_array_equal(yb, ey)
+        np.testing.assert_array_equal(xb, ex)
+        assert wb.all()
+
+
+def test_train_mode_forward_masks_padded_rows(mesh):
+    """A materialized train-mode forward must exclude padded (w=0) rows from
+    BatchNorm batch statistics, consistent with the grad/fused/scan steps:
+    real-row logits match a forward over just the real rows."""
+    from tpuddp.nn.core import Module
+
+    acc = Accelerator(mesh=mesh, seed=5)
+    module = nn.Sequential(nn.BatchNorm(), nn.Flatten(), nn.Linear(10))
+    model = acc.prepare(module)
+    model.train()
+    criterion = nn.CrossEntropyLoss()
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 4, 4, 3).astype(np.float32)
+    x[6:] = 100.0  # garbage padding rows that would skew batch stats
+    y = rs.randint(0, 10, 8)
+    w = np.ones(8, np.float32)
+    w[6:] = 0.0
+
+    out = model(x)
+    criterion(out, y, w)  # binds the weights to this forward
+    padded_logits = np.asarray(out)[:6]
+
+    real_logits = np.asarray(model(x[:6]))  # stats over the same 6 real rows
+    np.testing.assert_allclose(padded_logits, real_logits, rtol=1e-4, atol=1e-5)

@@ -68,6 +68,26 @@ def test_accelerate_entrypoint_end_to_end(tmp_path, capsys):
     assert "Finished Training." in out
 
 
+def test_accelerate_entrypoint_resume(tmp_path, capsys):
+    """training.resume on the managed path: a first run leaves
+    state_{epoch}.npz files; a restarted run restores the newest (weights +
+    optimizer moments + RNG position) and continues from the next epoch."""
+    from train_accelerate import basic_accelerate_training
+
+    training = dict(TINY_TRAINING, num_epochs=1, deferred_metrics=True)
+    basic_accelerate_training(str(tmp_path), training)
+    assert os.path.exists(tmp_path / "state_0.npz")
+    capsys.readouterr()
+
+    training = dict(TINY_TRAINING, num_epochs=2, resume=True, deferred_metrics=True)
+    basic_accelerate_training(str(tmp_path), training)
+    out = capsys.readouterr().out
+    assert "Resumed from epoch 0 state." in out
+    assert "Epoch 2/2" in out
+    assert "Epoch 1/2" not in out  # epoch 0 was not re-trained
+    assert os.path.exists(tmp_path / "state_1.npz")
+
+
 def test_submit_job_tpu_dry_run(tmp_path):
     settings = {
         "script_path": "train_native.py",

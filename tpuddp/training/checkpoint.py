@@ -118,10 +118,11 @@ def load(path: str, like: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def checkpoint_path(save_dir: str, epoch: int) -> str:
-    """``ckpt_{epoch}.npz`` — naming parity with the reference's
-    ``ckpt_{epoch}.pt`` (multi-GPU-training-torch.py:219-221)."""
-    return os.path.join(save_dir, f"ckpt_{epoch}.npz")
+def checkpoint_path(save_dir: str, epoch: int, prefix: str = "ckpt") -> str:
+    """``{prefix}_{epoch}.npz`` — default naming parity with the reference's
+    ``ckpt_{epoch}.pt`` (multi-GPU-training-torch.py:219-221); the managed
+    full-state files use ``prefix="state"``."""
+    return os.path.join(save_dir, f"{prefix}_{epoch}.npz")
 
 
 def save_on_main(save_dir: str, epoch: int, tree: Any) -> Optional[str]:
@@ -135,17 +136,15 @@ def save_on_main(save_dir: str, epoch: int, tree: Any) -> Optional[str]:
     return path
 
 
-_CKPT_RE = re.compile(r"^ckpt_(\d+)\.npz$")
-
-
-def latest(save_dir: str) -> Optional[Tuple[str, int]]:
+def latest(save_dir: str, prefix: str = "ckpt") -> Optional[Tuple[str, int]]:
     """Most recent ``(path, epoch)`` in ``save_dir``, or None. The resume
     helper the reference lacks (SURVEY.md §3.4)."""
     if not os.path.isdir(save_dir):
         return None
+    pat = re.compile(rf"^{re.escape(prefix)}_(\d+)\.npz$")
     best = None
     for name in os.listdir(save_dir):
-        m = _CKPT_RE.match(name)
+        m = pat.match(name)
         if m:
             epoch = int(m.group(1))
             if best is None or epoch > best[1]:
@@ -153,10 +152,10 @@ def latest(save_dir: str) -> Optional[Tuple[str, int]]:
     return best
 
 
-def restore_latest(save_dir: str, like: Any) -> Tuple[Any, int]:
+def restore_latest(save_dir: str, like: Any, prefix: str = "ckpt") -> Tuple[Any, int]:
     """Load the newest checkpoint into ``like``'s structure. Returns
     ``(tree, next_epoch)``; ``(like, 0)`` when none exists."""
-    found = latest(save_dir)
+    found = latest(save_dir, prefix)
     if found is None:
         return like, 0
     path, epoch = found

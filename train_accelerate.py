@@ -152,8 +152,9 @@ def run_training_loop(
     num_epochs=20,
     checkpoint_epoch=5,
     deferred_metrics=False,
+    start_epoch=0,
 ):
-    for epoch in range(num_epochs):
+    for epoch in range(start_epoch, num_epochs):
         train_loader.set_epoch(epoch)
         train_loss = train(
             model,
@@ -184,9 +185,11 @@ def run_training_loop(
 
         if epoch % checkpoint_epoch == 0:
             # barrier, then a single-writer save of the unwrapped weights
-            # (reference :104-108)
+            # (reference :104-108) PLUS the lossless full state (weights +
+            # optimizer moments + RNG position) that training.resume restores
             accelerator.wait_for_everyone()
             accelerator.save_model(model, save_dir)
+            accelerator.save_state(model, optimizer, save_dir, epoch=epoch)
 
     print("Finished Training.")
 
@@ -259,6 +262,21 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
             compute_dtype=cdtype,
         )
     )
+    # Managed resume (training.resume: true): restore the newest lossless
+    # state_{epoch}.npz in out_dir — weights, optimizer moments, RNG stream
+    # position. The structure to load into is created by one LAZY forward on
+    # a transformed single-sample probe (LazyForward materializes nothing and
+    # _ensure_init only reads shape/dtype, so no batch assembly, no prefetch
+    # thread, and only the transform's tiny dispatch runs).
+    start_epoch = 0
+    if training.get("resume"):
+        img0, _label0 = train_loader.dataset[0]
+        x0 = eval_transform(jnp.asarray(np.asarray(img0)[None]))
+        model(x0)
+        start_epoch = accelerator.load_state(model, optimizer, out_dir)
+        if start_epoch and accelerator.is_local_main_process:
+            print(f"Resumed from epoch {start_epoch - 1} state.")
+
     run_training_loop(
         model,
         training_dataloader,
@@ -272,6 +290,7 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         num_epochs=training["num_epochs"],
         checkpoint_epoch=training["checkpoint_epoch"],
         deferred_metrics=bool(training.get("deferred_metrics")),
+        start_epoch=start_epoch,
     )
 
 
