@@ -430,12 +430,13 @@ def main():
     import jax.numpy as jnp
 
     from tpuddp.data.transforms import make_train_augment
-    from tpuddp.models import AlexNet, ToyMLP
+    from tpuddp.models import AlexNet, ResNet18, ResNet34, ToyMLP
 
     # Headline: the toy model is dispatch-bound (its compute is ~13 us/step),
     # so throughput scales with the fusion depth K until staging/memory costs
-    # bite; K=200 measured 1.6M samples/s/chip (K=50: 0.6M, K=400: 2.5M but
-    # the flops probe's scan cross-check no longer resolves there).
+    # bite; K=200 measured 1.6-2.2M samples/s/chip across rounds (K=50:
+    # 0.6M, K=400: 2.5M but the flops probe's scan cross-check no longer
+    # resolves there).
     ours, n_chips = bench_config(
         "toy_mlp f32 (scan-fused K=200)", ToyMLP(num_classes=10), (32, 32, 3),
         128, steps=2000, scan=200,
@@ -445,13 +446,11 @@ def main():
         128, steps=256,
     )
 
-    def resnet18():
-        from tpuddp.models import ResNet18
-
+    def cifar_resnet(cls):
         # The TPU-friendly CIFAR recipe: a modern ResNet at the native 32x32
         # resolution instead of paying the reference's 49x resize FLOPs.
         return (
-            ResNet18(10, sync_bn=True, small_input=True),
+            cls(10, sync_bn=True, small_input=True),
             make_train_augment(size=None, compute_dtype=jnp.bfloat16),
         )
 
@@ -480,8 +479,10 @@ def main():
         # param+grad HBM traffic over 4x the samples
         ("alexnet bf16 224 b512 bf16-opt (scan-fused)", bf16_alexnet, 512, 4,
          24, bf16_opt),
-        ("resnet18 bf16 32x32 sync-BN (scan-fused)", resnet18, 128, 16, 96,
-         None),
+        ("resnet18 bf16 32x32 sync-BN (scan-fused)",
+         lambda: cifar_resnet(ResNet18), 128, 16, 96, None),
+        ("resnet34 bf16 32x32 sync-BN (scan-fused)",
+         lambda: cifar_resnet(ResNet34), 128, 16, 64, None),
     ]
     for name, make, batch, scan, steps, opt in cnn_configs:
         try:  # diagnostics only — independent, and never break the headline line

@@ -59,6 +59,34 @@ state, history = run_training_loop(
     num_epochs=2, checkpoint_epoch=1,
 )
 
+# --- custom-sampler order broadcast: a NON-deterministic user sampler drawn
+# independently per process must not break cross-process shard disjointness —
+# process 0's materialized order is broadcast to every process
+# (tpuddp/data/loader.py _EpochMemoizedOrder) ---
+import numpy as np  # noqa: E402
+
+
+class _UnseededRandomOrder:
+    """Deliberately different on every process: only the broadcast can make
+    the shards globally consistent."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __iter__(self):
+        return iter(np.random.default_rng().permutation(self.n))
+
+
+s_loader = ShardedDataLoader(ds, 4, mesh, sampler=_UnseededRandomOrder(len(ds)))
+sampler_shards = [s.local_indices().tolist() for s in s_loader.samplers]
+# set_epoch must invalidate the memo and re-broadcast a FRESH order (a stale
+# cache would replay epoch 0's order; a broadcast mismatch would deadlock)
+s_loader.set_epoch(1)
+sampler_shards_ep1 = [s.local_indices().tolist() for s in s_loader.samplers]
+
 # --- managed (Accelerator) path over the same multi-process mesh ---
 from tpuddp.accelerate import Accelerator  # noqa: E402
 from tpuddp.data import DataLoader  # noqa: E402
@@ -89,6 +117,8 @@ print(
             "n": [h["train_samples"] for h in history],
             "managed_losses": managed_losses,
             "is_main": acc.is_main_process,
+            "sampler_shards": sampler_shards,
+            "sampler_shards_ep1": sampler_shards_ep1,
         }
     ),
     flush=True,

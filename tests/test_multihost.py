@@ -73,6 +73,16 @@ def test_two_process_dp_world(tmp_path):
     )
     assert results[0]["is_main"] and not results[1]["is_main"]
 
+    # a custom sampler drawn independently (unseeded) per process still
+    # yields globally disjoint shards covering the dataset exactly once —
+    # proof that process 0's materialized order was broadcast
+    for key in ("sampler_shards", "sampler_shards_ep1"):
+        all_idx = [i for r in results for shard in r[key] for i in shard]
+        assert sorted(all_idx) == list(range(128))
+    # set_epoch invalidated the memo: epoch 1 re-drew (and re-broadcast) a
+    # fresh order rather than replaying epoch 0's cached one
+    assert results[0]["sampler_shards_ep1"] != results[0]["sampler_shards"]
+
     # process 0 only wrote the checkpoints; the loop's epoch log printed once
     assert os.path.exists(tmp_path / "ckpt_0.npz")
     assert os.path.exists(tmp_path / "ckpt_1.npz")
