@@ -57,12 +57,27 @@ def _looks_like_optimizer_update(shape_with_layout: str) -> bool:
     fused stateful-optimizer update — Adam's (new_param, m, v) riding on the
     weight-grad dot. (A 2-slot optimizer like SGD+momentum would need >= 2,
     but 2 identical outputs also matches fwd activation+stash pairs, so this
-    heuristic stays at 3; ops from tpuddp/optim sources are caught by name.)"""
+    heuristic stays at 3; ops from tpuddp/optim sources are caught by name.)
+
+    Under ``optimizer_state_dtype: bfloat16`` the tuple is
+    ``(f32[shape], bf16[shape], bf16[shape])`` — mixed dtypes, so the
+    same-dtype >=3 rule misses it. That exact mixed pattern (one f32 master
+    + >=2 low-precision moments of the SAME shape) is accepted as a second
+    signature; a blanket dtype-stripped >=3 count is NOT used because it
+    would also match fwd act+stash pairs plus an upcast."""
     if not shape_with_layout.startswith("("):
         return False
     tokens = _SHAPE_TOKEN.findall(shape_with_layout)
-    counts = collections.Counter(t.split("{")[0] for t in tokens)
-    return any(c >= 3 for c in counts.values())
+    by_dtype = collections.Counter()  # (dtype, shape) -> count
+    for t in tokens:
+        dtype, shape = t.split("[", 1)
+        by_dtype[(dtype, shape)] += 1
+    if any(c >= 3 for c in by_dtype.values()):
+        return True
+    return any(
+        dtype != "f32" and c >= 2 and by_dtype.get(("f32", shape), 0) >= 1
+        for (dtype, shape), c in by_dtype.items()
+    )
 
 
 def categorize(e) -> str:
