@@ -517,6 +517,53 @@ def test_gradient_accumulation_matches_big_batch(mesh):
 def test_accumulation_and_fuse_steps_are_exclusive(mesh):
     with pytest.raises(ValueError, match="exclusive"):
         Accelerator(mesh=mesh, fuse_steps=4, gradient_accumulation_steps=2)
+    # "auto" composes: accumulation owns the cadence, fusion yields
+    acc = Accelerator(mesh=mesh, fuse_steps="auto", gradient_accumulation_steps=2)
+    assert acc.fuse_steps == 1
+
+
+def test_auto_fuse_steps_resolves_by_model_size(mesh):
+    """fuse_steps='auto' resolves at the first step: deep fusion (32) for
+    dispatch-bound sub-4MB models — the BASELINE-measured policy, so the
+    entrypoint's auto mode matches what the bench publishes."""
+    acc = Accelerator(mesh=mesh, seed=3, fuse_steps="auto")
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(0.1))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+    assert acc.fuse_steps == "auto"
+    loss = criterion(model(x), y)
+    acc.backward(loss)
+    opt.step()
+    assert opt._fuse == 32  # ToyMLP(8) is far under the 4MB threshold
+    assert acc.fuse_steps == "auto"  # per-OPTIMIZER: other models resolve anew
+    assert len(opt._queue) == 1  # the step queued under the resolved depth
+    assert loss.item() > 0  # reads still flush correctly
+
+
+def test_short_epoch_partial_queue_flushes_as_one_scan(mesh):
+    """An epoch shorter than the fusion depth must still dispatch as ONE scan
+    at flush time — not silently degrade to per-step dispatches."""
+    acc = Accelerator(mesh=mesh, seed=4, fuse_steps=32)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(0.1))
+    criterion = nn.CrossEntropyLoss()
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8)
+    model(x)
+    losses = []
+    for _ in range(3):  # a 3-batch "epoch", far below fuse=32
+        loss = criterion(model(x), y)
+        acc.backward(loss)
+        opt.step()
+        losses.append(loss)
+    assert len(opt._queue) == 3
+    total = float(sum(l.device_value() for l in losses))  # triggers the flush
+    assert total > 0
+    assert opt._queue == []
+    # the 3-step remainder compiled (and ran) as a K=3 scan program
+    assert any(k[-1] == 3 for k in model._fused_scans)
+    # all three losses came from the scan's stacked losses, in order
+    assert losses[0].item() != losses[2].item()
 
 
 def test_partial_accumulation_cycle_flushes(mesh):

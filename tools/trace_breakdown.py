@@ -63,8 +63,11 @@ def _looks_like_optimizer_update(shape_with_layout: str) -> bool:
     ``(f32[shape], bf16[shape], bf16[shape])`` — mixed dtypes, so the
     same-dtype >=3 rule misses it. That exact mixed pattern (one f32 master
     + >=2 low-precision moments of the SAME shape) is accepted as a second
-    signature; a blanket dtype-stripped >=3 count is NOT used because it
-    would also match fwd act+stash pairs plus an upcast."""
+    signature. Caveat: a fwd op emitting a same-shape bf16 act+stash pair
+    PLUS an f32 upcast of that shape would match it too — tpuddp's traced
+    programs contain no such op (the per-bucket TF totals cross-check
+    against the model's known FLOPs; see BASELINE.md), but re-verify that
+    accounting if this tool is pointed at other programs."""
     if not shape_with_layout.startswith("("):
         return False
     tokens = _SHAPE_TOKEN.findall(shape_with_layout)

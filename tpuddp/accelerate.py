@@ -658,6 +658,10 @@ class PreparedOptimizer:
         # fuse_steps > 1: step() queues sharded pending steps here and runs
         # them K at a time as one lax.scan dispatch (flush())
         self._queue = []
+        # this optimizer's resolved fusion depth ("auto" resolves per MODEL,
+        # from its size, at the first step — a shared Accelerator may drive
+        # models of very different sizes, each deserving its own depth)
+        self._fuse = None
         # gradient_accumulation_steps > 1: running device-side grad sum
         self._accum_grads = None
         self._accum_count = 0
@@ -699,7 +703,22 @@ class PreparedOptimizer:
                 lazy_loss._value = loss
                 self._accumulate(grads, accum)
                 return
-            fuse = getattr(model.accelerator, "fuse_steps", 1)
+            fuse = self._fuse
+            if fuse is None:
+                fuse = getattr(model.accelerator, "fuse_steps", 1)
+                if fuse == "auto":
+                    # size-aware resolution, once per optimizer, now that
+                    # params exist: small (dispatch-bound) models fuse deeper.
+                    # Same SHAPE of policy as the native resolve_scan_steps
+                    # (size-keyed depth), different constant (32, the
+                    # BASELINE-measured managed sweet spot — each managed
+                    # step still pays per-batch sharded placement, so its
+                    # scaling flattens earlier than the native scan's 64).
+                    from tpuddp.training.loop import _SMALL_PARAM_BYTES, _param_bytes
+
+                    small = _param_bytes(model._params) < _SMALL_PARAM_BYTES
+                    fuse = 32 if small else 8
+                self._fuse = fuse
             if fuse > 1:
                 # queue the sharded step; K of them run as ONE scan dispatch.
                 # Reading params/loss values before the queue fills triggers
@@ -845,14 +864,17 @@ class PreparedOptimizer:
 
     def _dispatch_flush(self, queue):
         model = self.model
-        if len(queue) != getattr(model.accelerator, "fuse_steps", 1):
-            # partial flush (epoch remainder / early read): reuse the
-            # already-compiled single-step program instead of compiling a
-            # fresh scan for every distinct remainder length
-            for xb, yb, wb, criterion, step_idx, lazy_loss in queue:
-                self._run_fused(xb, yb, wb, criterion, step_idx, lazy_loss)
-                lazy_loss._queued_on = None
+        if len(queue) == 1:
+            xb, yb, wb, criterion, step_idx, lazy_loss = queue[0]
+            self._run_fused(xb, yb, wb, criterion, step_idx, lazy_loss)
+            lazy_loss._queued_on = None
             return
+        # Any multi-step queue — full, epoch remainder, or an early-read
+        # partial — dispatches as ONE scan. Scan programs are cached per
+        # length, and the lengths that occur recur (the full depth every
+        # cycle, the same remainder every epoch), so each compiles once per
+        # run; an epoch SHORTER than the fusion depth still gets exactly one
+        # dispatch per epoch instead of silently degrading to per-step.
         criterion = queue[0][3]
         fn = model._get_fused_scan_step(criterion, self.optimizer, len(queue))
         idxs = jnp.asarray([e[4] for e in queue], jnp.int32)
@@ -888,6 +910,12 @@ class Accelerator:
         lax.scan dispatch (the managed analog of the native scan fusion) —
         loss values then materialize at flush time, so pair it with deferred
         metric reading (collect the LazyLoss objects; read at epoch end).
+        ``"auto"`` resolves at each optimizer's first step from its model's
+        size: 32 for dispatch-bound small models (whole parameter set under
+        ~4 MB — the BASELINE-measured managed sweet spot), 8 otherwise. Same
+        size-keyed SHAPE as the native ``scan_steps: auto`` policy; the
+        constants differ (native small cap is 64) because each managed step
+        still pays per-batch sharded placement.
 
         ``num_chips``: restrict the data mesh to the first N local devices
         (the managed analog of ``local.tpu.num_chips`` — without it a
@@ -897,7 +925,10 @@ class Accelerator:
         key, _ = seeding.set_seed_based_on_rank(base_seed=seed)
         self._key = key
         self._models = []
-        self.fuse_steps = max(1, int(fuse_steps))
+        if fuse_steps in (None, "auto"):
+            self.fuse_steps = "auto"
+        else:
+            self.fuse_steps = max(1, int(fuse_steps))
         # clip the GLOBAL-batch gradient (already cross-replica aggregated
         # under jit) before the update — clip-after-aggregate semantics,
         # same as the native path's clip_grad_norm
@@ -909,11 +940,15 @@ class Accelerator:
         # steps (zero_grad stays safe to call every batch, as HF's managed
         # no-op semantics allow; the boundary step clears the accumulator).
         self.gradient_accumulation_steps = max(1, int(gradient_accumulation_steps))
-        if self.gradient_accumulation_steps > 1 and self.fuse_steps > 1:
-            raise ValueError(
-                "gradient_accumulation_steps and fuse_steps are mutually "
-                "exclusive (fused scan steps each apply an update)"
-            )
+        if self.gradient_accumulation_steps > 1:
+            if self.fuse_steps == "auto":
+                # accumulation owns the step cadence; auto-fusion yields
+                self.fuse_steps = 1
+            elif self.fuse_steps > 1:
+                raise ValueError(
+                    "gradient_accumulation_steps and fuse_steps are mutually "
+                    "exclusive (fused scan steps each apply an update)"
+                )
 
     # -- topology (HF property-name parity) --
     @property

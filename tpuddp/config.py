@@ -44,7 +44,8 @@ TRAINING_DEFAULTS = {
     "remat": False,  # jax.checkpoint: recompute activations in backward
     "prefetch": True,  # background-thread host batch prefetch
     "deferred_metrics": False,  # managed path: epoch-end (not per-batch) metric sync
-    "fuse_steps": "auto",  # managed path: K step()s per dispatch (auto: 8 if deferred)
+    "fuse_steps": "auto",  # managed path: K step()s per dispatch (auto, with
+    # deferred_metrics: size-resolved — 32 for sub-4MB models, 8 otherwise)
     "gradient_accumulation_steps": 1,  # managed path: averaged update every N steps
     "optimizer_state_dtype": None,  # Adam m/v storage dtype ("bfloat16" halves
     # optimizer HBM traffic; math stays f32). None -> params' dtype.

@@ -203,13 +203,15 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
     accum = int(training.get("gradient_accumulation_steps") or 1)
     fuse = training.get("fuse_steps", "auto")
     if fuse in (None, "auto"):
-        # auto fusion only when it composes: accumulation owns the step cadence
-        fuse = 8 if (training.get("deferred_metrics") and accum == 1) else 1
+        # fusion pays off only with deferred metric reads (an eager
+        # loss.item() per batch flushes the queue every step); "auto" then
+        # resolves size-aware inside the Accelerator at the first step
+        fuse = "auto" if training.get("deferred_metrics") else 1
     # an EXPLICIT fuse_steps conflicting with accumulation surfaces the
     # library's own mutually-exclusive error instead of a silent override
     accelerator = Accelerator(
         seed=training.get("seed"),
-        fuse_steps=int(fuse),
+        fuse_steps=fuse if fuse == "auto" else int(fuse),
         num_chips=num_chips,
         clip_grad_norm=training.get("clip_grad_norm"),
         gradient_accumulation_steps=accum,
