@@ -47,13 +47,19 @@ test_loader = ShardedDataLoader(ds, 4, mesh, shuffle=True, seed=0)
 local = train_loader.local_ranks
 assert len(local) == 4, local
 
+# weight_update_sharding=True: the moments are sharded ACROSS the two
+# processes (no host holds the full vector), exercising the reduce-scatter/
+# all-gather step collectives AND the cross-host gather inside the
+# checkpoint writer (checkpoint.save_on_main)
 ddp = DistributedDataParallel(
     ToyCNN(widths=(8,), sync_bn=True),
     optim.Adam(1e-2),
     nn.CrossEntropyLoss(),
     mesh=mesh,
+    weight_update_sharding=True,
 )
 state = ddp.init_state(jax.random.key(0), jnp.zeros((1, 8, 8, 3)))
+assert not state.opt_state.m.is_fully_addressable  # truly cross-host sharded
 state, history = run_training_loop(
     ddp, state, train_loader, test_loader, out_dir,
     num_epochs=2, checkpoint_epoch=1,

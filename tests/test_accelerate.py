@@ -541,6 +541,27 @@ def test_auto_fuse_steps_resolves_by_model_size(mesh):
     assert loss.item() > 0  # reads still flush correctly
 
 
+def test_ragged_batch_stream_flushes_homogeneous_prefix(mesh):
+    """A raw (unprepared) loader's smaller last batch must not crash the
+    fused-scan stack: the queue flushes its homogeneous prefix on a shape
+    change, then queues the new shape."""
+    acc = Accelerator(mesh=mesh, seed=6, fuse_steps=8)
+    model, opt = acc.prepare(ToyMLP(hidden=(8,)), optim.SGD(0.1))
+    criterion = nn.CrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    losses = []
+    for n in (16, 16, 16, 8):  # ragged tail, as a raw loop would produce
+        x = rs.randn(n, 4, 4, 3).astype(np.float32)
+        y = rs.randint(0, 10, n)
+        loss = criterion(model(x), y)
+        acc.backward(loss)
+        opt.step()
+        losses.append(loss)
+    total = float(sum(l.device_value() for l in losses))
+    assert total > 0 and np.isfinite(total)
+    assert opt._queue == []
+
+
 def test_short_epoch_partial_queue_flushes_as_one_scan(mesh):
     """An epoch shorter than the fusion depth must still dispatch as ONE scan
     at flush time — not silently degrade to per-step dispatches."""

@@ -1,0 +1,203 @@
+"""Weight-update sharding (ZeRO-1 over the data axis; the cross-replica
+weight-update recipe of arxiv.org/abs/2004.13336): the sharded update must be
+numerically the SAME training algorithm as the replicated one — only the
+memory/traffic layout changes — with Adam moments genuinely laid out sharded
+across the mesh. Reference hot loop being accelerated:
+/root/reference/multi-GPU-training-torch.py:109-132."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuddp import nn, optim
+from tpuddp.data import SyntheticClassification
+from tpuddp.models import ToyCNN, ToyMLP
+from tpuddp.parallel import make_mesh
+from tpuddp.parallel.ddp import DistributedDataParallel
+from tpuddp.training import checkpoint as ckpt
+from tpuddp.training.step import (
+    FlatParamSpec,
+    _tree_to_vec,
+    _vec_to_tree,
+    make_flat_param_spec,
+    stack_batches,
+)
+
+KEY = jax.random.key(0)
+
+
+def make_batch(n=64, seed=5, shape=(8, 8, 3)):
+    ds = SyntheticClassification(n=n, shape=shape, seed=seed)
+    x, y = ds.get_batch(np.arange(n))
+    return x, y, np.ones(n, np.float32)
+
+
+def build(mesh, wus, clip=None, opt=None, model=None, mode="shard_map"):
+    return DistributedDataParallel(
+        model if model is not None else ToyMLP(hidden=(16,)),
+        opt if opt is not None else optim.Adam(1e-2),
+        nn.CrossEntropyLoss(),
+        mesh=mesh,
+        mode=mode,
+        clip_grad_norm=clip,
+        weight_update_sharding=wus,
+    )
+
+
+def test_flat_spec_round_trip():
+    params = ({"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(3)}, jnp.zeros(()))
+    spec = make_flat_param_spec(params, world=4)
+    assert spec.total % 4 == 0 and spec.total >= 10
+    vec = _tree_to_vec(params, spec)
+    assert vec.shape == (spec.total,)
+    back = _vec_to_tree(vec, spec)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params, back,
+    )
+
+
+def test_flat_spec_rejects_non_f32_leaves():
+    with pytest.raises(ValueError, match="f32"):
+        make_flat_param_spec({"w": jnp.ones(4, jnp.bfloat16)}, world=2)
+
+
+def test_sharded_update_matches_replicated(cpu_devices):
+    """The whole point: same trajectory as the replicated update (reduce-
+    scatter + shard update + all-gather == allreduce + full update), down to
+    f32 reduction-order noise — with and without clipping."""
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+
+    def run(wus, clip):
+        ddp = build(mesh, wus, clip=clip)
+        st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        for _ in range(4):
+            st, m = ddp.train_step(st, ddp.shard((x, y, w)))
+        return st, float(np.sum(np.asarray(m["loss_sum"])))
+
+    for clip in (None, 0.05):
+        s_rep, l_rep = run(False, clip)
+        s_sh, l_sh = run(True, clip)
+        assert l_rep == pytest.approx(l_sh, rel=1e-6)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+            ),
+            s_rep.params, s_sh.params,
+        )
+
+
+def test_moments_are_laid_out_sharded(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    ddp = build(mesh, True)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    m = st.opt_state.m
+    assert m.ndim == 1 and m.shape[0] % 8 == 0
+    # each device holds exactly its 1/8 slice — the N-fold memory saving
+    assert m.addressable_shards[0].data.shape == (m.shape[0] // 8,)
+    assert str(m.sharding.spec) == str(jax.sharding.PartitionSpec("data"))
+    st, _ = ddp.train_step(st, ddp.shard((x, y, w)))
+    assert st.opt_state.m.addressable_shards[0].data.shape == (m.shape[0] // 8,)
+
+
+def test_scan_step_and_eval_with_sharded_state(cpu_devices):
+    """The K-fused scan and the eval pass must accept the sharded state."""
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    ddp = build(mesh, True)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    stacked = ddp.shard_stacked(stack_batches([(x, y, w), (x, y, w)]))
+    st, m = ddp.train_step_many(st, stacked)
+    assert np.isfinite(np.sum(np.asarray(m["loss_sum"])))
+    ev = ddp.eval_step(st, ddp.shard((x, y, w)))
+    assert float(np.sum(np.asarray(ev["n"]))) == 64
+    ev2 = ddp.eval_step_many(st, stacked)
+    assert float(np.sum(np.asarray(ev2["n"]))) == 128
+
+
+def test_sharded_state_checkpoint_round_trip(cpu_devices, tmp_path):
+    """Checkpointing gathers the sharded moments into the (total,) global
+    vector; restore re-places them sharded and training continues."""
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    ddp = build(mesh, True)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    st, _ = ddp.train_step(st, ddp.shard((x, y, w)))
+    path = ckpt.save(str(tmp_path / "wus.npz"), st)
+    template = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    restored = ckpt.load(path, template)
+    np.testing.assert_array_equal(
+        np.asarray(restored.opt_state.m), np.asarray(st.opt_state.m)
+    )
+    # restored (host-side) state steps again: the jit's in_specs re-place it,
+    # moments land sharded — the native resume flow needs no special casing
+    restored2, _ = ddp.train_step(restored, ddp.shard((x, y, w)))
+    assert int(np.asarray(restored2.step)) == 2
+    assert restored2.opt_state.m.addressable_shards[0].data.shape[0] * 8 == (
+        restored2.opt_state.m.shape[0]
+    )
+
+
+def test_wus_composes_with_bf16_moments_and_syncbn(cpu_devices):
+    """optimizer_state_dtype=bfloat16 (sharded bf16 moments) and SyncBN
+    both compose with the sharded update."""
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch(shape=(8, 8, 3))
+    ddp = build(
+        mesh, True,
+        opt=optim.Adam(1e-2, state_dtype="bfloat16"),
+        model=ToyCNN(widths=(8,), sync_bn=True),
+    )
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    assert st.opt_state.m.dtype == jnp.bfloat16
+    first = None
+    for i in range(6):
+        st, m = ddp.train_step(st, ddp.shard((x, y, w)))
+        if first is None:
+            first = float(np.sum(np.asarray(m["loss_sum"])))
+    last = float(np.sum(np.asarray(m["loss_sum"])))
+    # functional, not bit-exact: the dither realization differs from the
+    # replicated layout (see optim.py layout note), but training must
+    # actually learn and the moments must not freeze
+    assert np.isfinite(last) and last < first
+    assert float(np.max(np.abs(np.asarray(st.opt_state.v)))) > 0
+    assert st.opt_state.m.addressable_shards[0].data.shape[0] * 8 == st.opt_state.m.shape[0]
+
+
+def test_wus_requires_shard_map_mode(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    with pytest.raises(ValueError, match="shard_map"):
+        build(mesh, True, mode="auto")
+
+
+def test_wus_step_before_init_raises(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    ddp = build(mesh, True)
+    with pytest.raises(RuntimeError, match="init_state"):
+        ddp.train_step(None, ddp.shard((x, y, w)))
+
+
+def test_wus_with_sgd_momentum(cpu_devices):
+    """The flat-shard update is optimizer-agnostic: SGD+momentum's buffer
+    shards the same way and matches the replicated trajectory."""
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+
+    def run(wus):
+        ddp = build(mesh, wus, opt=optim.SGD(0.1, momentum=0.9))
+        st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        for _ in range(3):
+            st, _ = ddp.train_step(st, ddp.shard((x, y, w)))
+        return st
+
+    s_rep, s_sh = run(False), run(True)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        s_rep.params, s_sh.params,
+    )

@@ -236,14 +236,25 @@ class FusedEvaluator:
     """
 
     def __init__(self, model: "PreparedModel", criterion, transform=None,
-                 fuse_steps: int = 8):
+                 fuse_steps=None):
         self.model = model
         self.criterion = criterion
         self.transform = transform
-        self.fuse_steps = max(1, int(fuse_steps))
+        # None = size-resolved at first use (32 for sub-4MB dispatch-bound
+        # models, 8 otherwise — the same policy as the train-side fuse auto)
+        self.fuse_steps = None if fuse_steps is None else max(1, int(fuse_steps))
         self._queue = []
         self._stats = None
         self._progs = {}
+
+    def _resolve_fuse(self) -> int:
+        if self.fuse_steps is not None:
+            return self.fuse_steps
+        params = self.model._params
+        if params is None or params is _LOST_TO_FAILED_FLUSH:
+            return 8  # tentative; cache only once the real size is known
+        self.fuse_steps = _resolve_auto_fuse(params)
+        return self.fuse_steps
 
     def add(self, x, y, w=None):
         if w is None:
@@ -254,7 +265,7 @@ class FusedEvaluator:
         if self._queue and self._queue[0][0] != shape_key:
             self._flush()  # ragged stream: never stack mixed shapes
         self._queue.append((shape_key, x, y, w))
-        if len(self._queue) >= self.fuse_steps:
+        if len(self._queue) >= self._resolve_fuse():
             self._flush()
 
     def _get_prog(self, k: int):
@@ -335,6 +346,16 @@ class FusedEvaluator:
         sums = jax.device_get(self._stats)
         self._stats = None
         return float(sums[0]), int(sums[1]), int(sums[2])
+
+
+def _resolve_auto_fuse(params) -> int:
+    """The managed size-aware fusion depth: 32 for dispatch-bound small
+    models (whole parameter set under ~4 MB), 8 otherwise — the
+    BASELINE-measured policy, shared by the train-side fuse_steps="auto"
+    and the FusedEvaluator so the two can't drift apart."""
+    from tpuddp.training.loop import _SMALL_PARAM_BYTES, _param_bytes
+
+    return 32 if _param_bytes(params) < _SMALL_PARAM_BYTES else 8
 
 
 class _LostState:
@@ -708,22 +729,25 @@ class PreparedOptimizer:
                 fuse = getattr(model.accelerator, "fuse_steps", 1)
                 if fuse == "auto":
                     # size-aware resolution, once per optimizer, now that
-                    # params exist: small (dispatch-bound) models fuse deeper.
-                    # Same SHAPE of policy as the native resolve_scan_steps
-                    # (size-keyed depth), different constant (32, the
-                    # BASELINE-measured managed sweet spot — each managed
-                    # step still pays per-batch sharded placement, so its
-                    # scaling flattens earlier than the native scan's 64).
-                    from tpuddp.training.loop import _SMALL_PARAM_BYTES, _param_bytes
-
-                    small = _param_bytes(model._params) < _SMALL_PARAM_BYTES
-                    fuse = 32 if small else 8
+                    # params exist: small (dispatch-bound) models fuse
+                    # deeper. Same SHAPE of policy as the native
+                    # resolve_scan_steps (size-keyed depth), different
+                    # constant — each managed step still pays per-batch
+                    # sharded placement, so its scaling flattens earlier
+                    # than the native scan's 64.
+                    fuse = _resolve_auto_fuse(model._params)
                 self._fuse = fuse
             if fuse > 1:
                 # queue the sharded step; K of them run as ONE scan dispatch.
                 # Reading params/loss values before the queue fills triggers
                 # an early flush, so semantics never depend on the queue.
-                if self._queue and self._queue[0][3] is not criterion:
+                if self._queue and (
+                    self._queue[0][3] is not criterion
+                    # ragged stream (e.g. a raw smaller last batch from an
+                    # unprepared loader): never stack mixed shapes — flush
+                    # the homogeneous prefix first
+                    or self._queue[0][0].shape != xb.shape
+                ):
                     self.flush()
                 self._queue.append((xb, yb, wb, criterion, step_idx, lazy_loss))
                 lazy_loss._queued_on = self

@@ -42,6 +42,9 @@ TRAINING_DEFAULTS = {
     # clip-before-aggregate caveat: clipping per-shard grads then averaging
     # would differ; tpuddp clips after the pmean, identically on all replicas)
     "remat": False,  # jax.checkpoint: recompute activations in backward
+    "weight_update_sharding": False,  # ZeRO-1 on ICI (arxiv 2004.13336):
+    # reduce-scatter grads, 1/N-shard optimizer update per chip (moments
+    # sharded over the data axis), all-gather params. shard_map mode only.
     "prefetch": True,  # background-thread host batch prefetch
     "deferred_metrics": False,  # managed path: epoch-end (not per-batch) metric sync
     "fuse_steps": "auto",  # managed path: K step()s per dispatch (auto, with

@@ -95,6 +95,14 @@ def _stochastic_round_bf16(x: jax.Array, step: jax.Array, salt: int) -> jax.Arra
     fixed element i the noise over steps t visits all 2^16 thresholds exactly
     once per 2^16 steps — *exact* temporal equidistribution, which is the
     property that keeps the EMA unbiased.
+
+    Layout note: ``i`` is the element's index within the array being rounded,
+    so the same logical parameter gets a DIFFERENT (equally valid, still
+    unbiased — the per-element temporal equidistribution holds for any fixed
+    i) noise realization under weight-update sharding, where moments are
+    rounded as flat per-replica shards instead of per-leaf trees. bf16-moment
+    runs are therefore reproducible within a layout but not bit-identical
+    across layouts.
     """
     bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
     flat_iota = jax.lax.iota(jnp.uint32, x.size).reshape(x.shape)

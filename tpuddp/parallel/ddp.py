@@ -45,7 +45,14 @@ class DistributedDataParallel:
         augment: Optional[Callable] = None,
         eval_transform: Optional[Callable] = None,
         remat: bool = False,
+        weight_update_sharding: bool = False,
     ):
+        """``weight_update_sharding``: shard the optimizer update + moments
+        across the data axis (reduce-scatter grads, update a 1/N parameter
+        shard per replica, all-gather new params — the cross-replica
+        weight-update sharding of arxiv.org/abs/2004.13336 / ZeRO-1).
+        N-fold less optimizer memory and update HBM traffic per chip; same
+        interconnect bytes as the plain allreduce. shard_map mode only."""
         self.model = model
         self.optimizer = optimizer
         self.criterion = criterion if criterion is not None else CrossEntropyLoss()
@@ -56,11 +63,20 @@ class DistributedDataParallel:
         step_lib._validate_sync_buffers(
             model, step_lib.DATA_AXIS if mode == "shard_map" else None, sync_buffers
         )
+        if weight_update_sharding and mode != "shard_map":
+            raise ValueError(
+                "weight_update_sharding requires mode='shard_map' (the "
+                "reduce-scatter/all-gather exchange is expressed over the "
+                "explicit per-replica step's named axis)"
+            )
         self.sync_buffers = sync_buffers
         self.clip_grad_norm = clip_grad_norm
         self.augment = augment
         self.eval_transform = eval_transform
         self.remat = remat
+        self.weight_update_sharding = bool(weight_update_sharding)
+        self._wus_spec = None
+        self._state_spec = None
         self._train_step = None
         self._eval_step = None
         self._scan_step = None
@@ -98,8 +114,53 @@ class DistributedDataParallel:
             )
         else:
             state = create_train_state(self.model, self.optimizer, key, sample_input)
+        if self.weight_update_sharding:
+            # re-derive the optimizer state over the FLAT padded parameter
+            # vector: moments become (total,) arrays laid out sharded over
+            # the data axis (each replica materializes only its 1/N slice)
+            self._wus_spec = step_lib.make_flat_param_spec(
+                state.params, self.world_size
+            )
+            opt_state = self.optimizer.init(
+                jnp.zeros((self._wus_spec.total,), jnp.float32)
+            )
+            self._state_spec = step_lib.sharded_state_spec(
+                opt_state, self._wus_spec
+            )
+            state = TrainState(
+                params=state.params,
+                model_state=state.model_state,
+                opt_state=opt_state,
+                step=state.step,
+                rng=state.rng,
+            )
         state = col.broadcast_one_to_all(state)
-        return replicate(self.mesh, state)
+        if not self.weight_update_sharding:
+            return replicate(self.mesh, state)
+        # placement: everything replicated EXCEPT the (total,)-sized
+        # optimizer vectors, which shard over the data axis
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        total = self._wus_spec.total
+        sharded = NamedSharding(self.mesh, P(step_lib.DATA_AXIS))
+
+        def place_opt(leaf):
+            if getattr(leaf, "ndim", None) == 1 and leaf.shape[0] == total:
+                import numpy as np
+
+                host = np.asarray(leaf)
+                return jax.make_array_from_callback(
+                    (total,), sharded, lambda idx: host[idx]
+                )
+            return replicate(self.mesh, leaf)
+
+        return TrainState(
+            params=replicate(self.mesh, state.params),
+            model_state=replicate(self.mesh, state.model_state),
+            opt_state=jax.tree_util.tree_map(place_opt, state.opt_state),
+            step=replicate(self.mesh, state.step),
+            rng=replicate(self.mesh, state.rng),
+        )
 
     def shard(self, batch):
         """Place a host batch onto the mesh, split over the data axis."""
@@ -120,10 +181,18 @@ class DistributedDataParallel:
 
         return jax.tree_util.tree_map(_put, stacked_batch)
 
+    def _check_wus_ready(self):
+        if self.weight_update_sharding and self._wus_spec is None:
+            raise RuntimeError(
+                "weight_update_sharding derives its flat layout from the "
+                "initialized parameters; call init_state before the first step"
+            )
+
     def train_step_many(self, state: TrainState, stacked_batch):
         """K fused train steps per dispatch (lax.scan; see
         training.step.build_train_scan_step)."""
         if self._scan_step is None:
+            self._check_wus_ready()
             self._scan_step = step_lib.build_train_scan_step(
                 self.model,
                 self.criterion,
@@ -134,11 +203,14 @@ class DistributedDataParallel:
                 clip_grad_norm=self.clip_grad_norm,
                 augment=self.augment,
                 remat=self.remat,
+                wus_spec=self._wus_spec,
+                state_spec=self._state_spec,
             )
         return self._scan_step(state, stacked_batch)
 
     def train_step(self, state: TrainState, batch):
         if self._train_step is None:
+            self._check_wus_ready()
             self._train_step = step_lib.build_train_step(
                 self.model,
                 self.criterion,
@@ -149,6 +221,8 @@ class DistributedDataParallel:
                 clip_grad_norm=self.clip_grad_norm,
                 augment=self.augment,
                 remat=self.remat,
+                wus_spec=self._wus_spec,
+                state_spec=self._state_spec,
             )
         return self._train_step(state, batch)
 
@@ -156,23 +230,27 @@ class DistributedDataParallel:
         """K fused eval batches per dispatch (lax.scan; see
         training.step.build_eval_scan_step)."""
         if self._eval_scan_step is None:
+            self._check_wus_ready()
             self._eval_scan_step = step_lib.build_eval_scan_step(
                 self.model,
                 self.criterion,
                 self.mesh,
                 mode=self.mode,
                 transform=self.eval_transform,
+                state_spec=self._state_spec,
             )
         return self._eval_scan_step(state, stacked_batch)
 
     def eval_step(self, state: TrainState, batch):
         if self._eval_step is None:
+            self._check_wus_ready()
             self._eval_step = step_lib.build_eval_step(
                 self.model,
                 self.criterion,
                 self.mesh,
                 mode=self.mode,
                 transform=self.eval_transform,
+                state_spec=self._state_spec,
             )
         return self._eval_step(state, batch)
 

@@ -125,9 +125,31 @@ def checkpoint_path(save_dir: str, epoch: int, prefix: str = "ckpt") -> str:
     return os.path.join(save_dir, f"{prefix}_{epoch}.npz")
 
 
+def _gather_cross_host_shards(tree: Any) -> Any:
+    """Materialize leaves that are sharded ACROSS hosts (weight-update-sharded
+    optimizer moments: no single process holds the full vector) as host
+    arrays. A collective — every process must call it, which is why it runs
+    BEFORE the process-0 gating in :func:`save_on_main`. Replicated
+    multi-host arrays are locally complete and need no exchange."""
+    def g(leaf):
+        if (
+            isinstance(leaf, jax.Array)
+            and not leaf.is_fully_addressable
+            and not leaf.sharding.is_fully_replicated
+        ):
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(leaf, tiled=True)
+        return leaf
+
+    return jax.tree_util.tree_map(g, tree)
+
+
 def save_on_main(save_dir: str, epoch: int, tree: Any) -> Optional[str]:
     """Process-0-only save + barrier — the reference's writer discipline
     (:217-223). Returns the path on process 0, None elsewhere."""
+    if jax.process_count() > 1:
+        tree = _gather_cross_host_shards(tree)
     path = None
     if jax.process_index() == 0:
         os.makedirs(save_dir, exist_ok=True)

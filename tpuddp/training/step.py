@@ -29,10 +29,12 @@ fused into the forward pass by XLA.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+import math
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpuddp import optim as _optim
@@ -41,6 +43,71 @@ from tpuddp.parallel import collectives as col
 from tpuddp.parallel.mesh import DATA_AXIS, data_sharded, replicated
 from tpuddp.seeding import fold_in_axis_index
 from tpuddp.training.train_state import TrainState
+
+
+class FlatParamSpec(NamedTuple):
+    """Static flattening metadata for weight-update sharding: the parameter
+    pytree viewed as ONE f32 vector, zero-padded to a ``world``-multiple so
+    every replica owns an equal contiguous shard."""
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    sizes: Tuple[int, ...]
+    total: int  # padded length (world * shard size)
+    world: int
+
+
+def make_flat_param_spec(params, world: int) -> FlatParamSpec:
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    for i, leaf in enumerate(flat):
+        if jnp.asarray(leaf).dtype != jnp.float32:
+            raise ValueError(
+                "weight_update_sharding flattens parameters into one f32 "
+                f"vector; leaf {i} has dtype {jnp.asarray(leaf).dtype} "
+                "(tpuddp keeps f32 master params — mixed compute dtypes live "
+                "in activations, not parameters)"
+            )
+    shapes = tuple(tuple(int(d) for d in np.shape(l)) for l in flat)
+    sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+    raw = sum(sizes)
+    total = world * math.ceil(raw / world)
+    return FlatParamSpec(treedef, shapes, sizes, total, world)
+
+
+def _tree_to_vec(tree, spec: FlatParamSpec):
+    """Concatenate a pytree's leaves (ravel order = tree_flatten order) into
+    the spec's padded (total,) f32 vector."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    flat = jnp.concatenate([jnp.ravel(l) for l in leaves])
+    pad = spec.total - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def _vec_to_tree(vec, spec: FlatParamSpec):
+    leaves, offset = [], 0
+    for shape, size in zip(spec.shapes, spec.sizes):
+        chunk = jax.lax.slice(vec, (offset,), (offset + size,))
+        leaves.append(chunk.reshape(shape))
+        offset += size
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)
+
+
+def sharded_state_spec(opt_state_template, spec: FlatParamSpec):
+    """The shard_map PartitionSpec pytree for a TrainState whose optimizer
+    moment vectors are sharded over the data axis (weight-update sharding):
+    every (total,)-sized 1-D leaf of the optimizer state is P(DATA_AXIS),
+    everything else replicated."""
+    def leaf_spec(l):
+        if getattr(l, "ndim", None) == 1 and l.shape[0] == spec.total:
+            return P(DATA_AXIS)
+        return P()
+
+    opt_spec = jax.tree_util.tree_map(leaf_spec, opt_state_template)
+    return TrainState(
+        params=P(), model_state=P(), opt_state=opt_spec, step=P(), rng=P()
+    )
 
 
 def _split_step_rng(state: TrainState, axis_name: Optional[str]):
@@ -91,8 +158,15 @@ def _make_train_core(
     clip_grad_norm: Optional[float],
     augment: Optional[Callable],
     remat: bool = False,
+    wus_spec: Optional[FlatParamSpec] = None,
 ):
     _validate_sync_buffers(model, axis_name, sync_buffers)
+    if wus_spec is not None and axis_name is None:
+        raise ValueError(
+            "weight_update_sharding needs the explicit per-replica step "
+            "(mode='shard_map'): the reduce-scatter/all-gather exchange is "
+            "expressed over its named data axis"
+        )
     # Rematerialization: trade FLOPs for HBM by recomputing activations in the
     # backward pass (jax.checkpoint) — how large models/batches fit on-chip.
     apply_fn = model.apply
@@ -123,16 +197,63 @@ def _make_train_core(
             state.params
         )
 
-        if axis_name is not None:
-            # THE DDP step: average gradients across replicas (reference :125's
-            # implicit NCCL allreduce). In auto mode XLA inserts this itself.
-            grads = col.pmean(grads, axis_name)
-        if clip_grad_norm is not None:
-            # clip-before-aggregate caveat (reference README): clip the
-            # *averaged* grad, identically on all replicas.
-            grads, _ = _optim.clip_grad_norm_(grads, clip_grad_norm)
+        if wus_spec is not None:
+            # Weight-update sharding (the cross-replica weight-update recipe
+            # of arxiv.org/abs/2004.13336, ZeRO-1's TPU-native shape): instead
+            # of every replica all-reducing the full gradient and redundantly
+            # running the identical optimizer update over ALL parameters,
+            # reduce-scatter hands each replica the averaged gradient for its
+            # 1/N contiguous shard of the flattened parameter vector; each
+            # replica updates only that shard (with its 1/N slice of the
+            # optimizer moments — m/v live SHARDED across the mesh, an N-fold
+            # optimizer-memory and update-HBM-traffic saving); the new shards
+            # are all-gathered back into replicated parameters over ICI.
+            # Same bytes on the interconnect as the allreduce (scatter+gather
+            # IS an allreduce), 1/N of the optimizer's HBM round trip.
+            world = wus_spec.world
+            shard_n = wus_spec.total // world
+            g_vec = _tree_to_vec(grads, wus_spec)
+            g_shard = (
+                jax.lax.psum_scatter(
+                    g_vec, axis_name, scatter_dimension=0, tiled=True
+                )
+                / world
+            )
+            if clip_grad_norm is not None:
+                # the global norm of a sharded vector is one scalar psum away;
+                # padding zeros contribute nothing
+                norm = jnp.sqrt(
+                    jax.lax.psum(jnp.sum(jnp.square(g_shard)), axis_name)
+                )
+                g_shard = g_shard * jnp.minimum(
+                    1.0, clip_grad_norm / (norm + 1e-6)
+                )
+            idx = jax.lax.axis_index(axis_name)
+            p_vec = _tree_to_vec(state.params, wus_spec)
+            p_shard = jax.lax.dynamic_slice(
+                p_vec, (idx * shard_n,), (shard_n,)
+            )
+            new_p_shard, new_opt_state = optimizer.update(
+                g_shard, state.opt_state, p_shard
+            )
+            new_p_vec = jax.lax.all_gather(
+                new_p_shard, axis_name, tiled=True
+            )
+            new_params = _vec_to_tree(new_p_vec, wus_spec)
+        else:
+            if axis_name is not None:
+                # THE DDP step: average gradients across replicas (reference
+                # :125's implicit NCCL allreduce). In auto mode XLA inserts
+                # this itself.
+                grads = col.pmean(grads, axis_name)
+            if clip_grad_norm is not None:
+                # clip-before-aggregate caveat (reference README): clip the
+                # *averaged* grad, identically on all replicas.
+                grads, _ = _optim.clip_grad_norm_(grads, clip_grad_norm)
 
-        new_params, new_opt_state = optimizer.update(grads, state.opt_state, state.params)
+            new_params, new_opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
 
         if axis_name is not None and sync_buffers == "broadcast":
             # torch DDP's default broadcast_buffers=True: unsynced BN buffers
@@ -189,26 +310,31 @@ def build_train_step(
     clip_grad_norm: Optional[float] = None,
     augment: Optional[Callable] = None,
     remat: bool = False,
+    wus_spec: Optional[FlatParamSpec] = None,
+    state_spec=None,
 ):
     """Compile the DP train step over ``mesh``. Returns
-    ``step(state, (x, y, w)) -> (new_state, metrics)`` with donated state."""
+    ``step(state, (x, y, w)) -> (new_state, metrics)`` with donated state.
+    ``wus_spec``/``state_spec`` (from :func:`make_flat_param_spec` /
+    :func:`sharded_state_spec`) switch on weight-update sharding."""
     if mode == "shard_map":
+        st_spec = state_spec if state_spec is not None else P()
         core = _make_train_core(
             model, criterion, optimizer, DATA_AXIS, sync_buffers,
-            clip_grad_norm, augment, remat,
+            clip_grad_norm, augment, remat, wus_spec=wus_spec,
         )
         fn = jax.shard_map(
             core,
             mesh=mesh,
-            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(P(), {"loss_sum": P(DATA_AXIS), "n": P(DATA_AXIS)}),
+            in_specs=(st_spec, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=(st_spec, {"loss_sum": P(DATA_AXIS), "n": P(DATA_AXIS)}),
             check_vma=False,
         )
         jitted = jax.jit(fn, donate_argnums=0)
     elif mode == "auto":
         core = _make_train_core(
             model, criterion, optimizer, None, sync_buffers,
-            clip_grad_norm, augment, remat,
+            clip_grad_norm, augment, remat, wus_spec=wus_spec,
         )
         jitted = jax.jit(
             core,
@@ -236,6 +362,8 @@ def build_train_scan_step(
     clip_grad_norm: Optional[float] = None,
     augment: Optional[Callable] = None,
     remat: bool = False,
+    wus_spec: Optional[FlatParamSpec] = None,
+    state_spec=None,
 ):
     """Multi-step variant: runs K train steps per jit call via ``lax.scan``.
 
@@ -257,7 +385,7 @@ def build_train_scan_step(
 
     core = _make_train_core(
         model, criterion, optimizer, axis_name, sync_buffers,
-        clip_grad_norm, augment, remat,
+        clip_grad_norm, augment, remat, wus_spec=wus_spec,
     )
 
     def multi(state: TrainState, xs, ys, ws):
@@ -271,11 +399,12 @@ def build_train_scan_step(
         return state, metrics
 
     if mode == "shard_map":
+        st_spec = state_spec if state_spec is not None else P()
         fn = jax.shard_map(
             multi,
             mesh=mesh,
-            in_specs=(P(), in_batch, in_batch, in_batch),
-            out_specs=(P(), {"loss_sum": metric_spec, "n": metric_spec}),
+            in_specs=(st_spec, in_batch, in_batch, in_batch),
+            out_specs=(st_spec, {"loss_sum": metric_spec, "n": metric_spec}),
             check_vma=False,
         )
         jitted = jax.jit(fn, donate_argnums=0)
@@ -310,15 +439,22 @@ def build_eval_step(
     mesh,
     mode: str = "shard_map",
     transform: Optional[Callable] = None,
+    state_spec=None,
 ):
     """Compile the DP eval step: ``eval_step(state, (x, y, w)) -> metrics``
-    (per-replica partial sums in shard_map mode, global sums in auto mode)."""
+    (per-replica partial sums in shard_map mode, global sums in auto mode).
+    ``state_spec`` describes a weight-update-sharded TrainState (the eval
+    core never reads the optimizer state, but the input placement must
+    match)."""
     if mode == "shard_map":
         core = _make_eval_core(model, criterion, DATA_AXIS, transform)
         fn = jax.shard_map(
             core,
             mesh=mesh,
-            in_specs=(P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            in_specs=(
+                state_spec if state_spec is not None else P(),
+                P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+            ),
             out_specs={"loss_sum": P(DATA_AXIS), "correct": P(DATA_AXIS), "n": P(DATA_AXIS)},
             check_vma=False,
         )
@@ -346,6 +482,7 @@ def build_eval_scan_step(
     mesh,
     mode: str = "shard_map",
     transform: Optional[Callable] = None,
+    state_spec=None,
 ):
     """Multi-batch eval variant: K eval batches per jit call via ``lax.scan``
     over a ``(K, batch, ...)`` stack, returning summed metrics — the eval-pass
@@ -371,7 +508,10 @@ def build_eval_scan_step(
         fn = jax.shard_map(
             multi,
             mesh=mesh,
-            in_specs=(P(), in_batch, in_batch, in_batch),
+            in_specs=(
+                state_spec if state_spec is not None else P(),
+                in_batch, in_batch, in_batch,
+            ),
             out_specs={
                 "loss_sum": P(DATA_AXIS),
                 "correct": P(DATA_AXIS),

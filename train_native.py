@@ -118,6 +118,8 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
     )
 
     # The DDP wrap (reference :245): builds the shard_map'd pmean train step.
+    # weight_update_sharding swaps the allreduce+replicated-update for
+    # reduce-scatter + 1/N-shard update + all-gather (ZeRO-1 on ICI).
     clip = training.get("clip_grad_norm")
     ddp = DistributedDataParallel(
         model,
@@ -129,6 +131,7 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         eval_transform=eval_transform,
         remat=bool(training.get("remat", False)),
         clip_grad_norm=float(clip) if clip is not None else None,
+        weight_update_sharding=bool(training.get("weight_update_sharding", False)),
     )
     in_hw = size if size else train_ds.images.shape[1]
     state = ddp.init_state(
