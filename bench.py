@@ -329,11 +329,12 @@ def bench_managed(batch_per_chip=128, steps=60, deferred=False, fuse=1):
     return sps / n_chips
 
 
-def bench_managed_eval(batch_per_chip=128, batches=256, fused=True, fuse_k=8):
+def bench_managed_eval(batch_per_chip=128, batches=256, fused=True, fuse_k=None):
     """The managed eval pass on the toy MLP: the facade loop (2+ dispatches
     per test batch: transform, forward, plus per-batch metric ops) vs the
-    FusedEvaluator (ONE scan dispatch per ``fuse_k`` batches + one final
-    fetch — the managed analog of the native eval scan)."""
+    FusedEvaluator (ONE scan dispatch per K batches + one final fetch — the
+    managed analog of the native eval scan). ``fuse_k=None`` measures the
+    product default (size-resolved K)."""
     import jax
     import jax.numpy as jnp
 
@@ -359,6 +360,7 @@ def bench_managed_eval(batch_per_chip=128, batches=256, fused=True, fuse_k=8):
 
     if fused:
         ev = FusedEvaluator(model, criterion, transform=transform, fuse_steps=fuse_k)
+        fuse_k = ev._resolve_fuse()  # the size-resolved product default
 
         def run(n):
             for _ in range(n):
@@ -366,6 +368,7 @@ def bench_managed_eval(batch_per_chip=128, batches=256, fused=True, fuse_k=8):
             loss_sum, _, total = ev.finalize()
             assert np.isfinite(loss_sum) and total == n * batch_per_chip
     else:
+        fuse_k = fuse_k or 8  # warmup count only; the facade has no fusion
 
         def run(n):
             loss_sum = 0.0

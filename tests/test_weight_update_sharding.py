@@ -181,6 +181,26 @@ def test_wus_step_before_init_raises(cpu_devices):
         ddp.train_step(None, ddp.shard((x, y, w)))
 
 
+def test_wus_with_caller_supplied_params(cpu_devices):
+    """The pretrained fine-tune path (init_state(params=..., model_state=...))
+    composes: the flat optimizer layout is re-derived over the supplied
+    params and the imported weights are what trains."""
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    model = ToyMLP(hidden=(16,))
+    params, mstate = model.init(jax.random.key(7), jnp.zeros((1, 8, 8, 3)))
+    marked = jax.tree_util.tree_map(lambda l: l + 0.5, params)  # recognizable
+    ddp = build(mesh, True, model=model)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)), params=marked, model_state=mstate)
+    for got, want in zip(
+        jax.tree_util.tree_leaves(st.params), jax.tree_util.tree_leaves(marked)
+    ):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+    assert st.opt_state.m.ndim == 1  # flat sharded layout, not the param tree
+    st, m = ddp.train_step(st, ddp.shard((x, y, w)))
+    assert np.isfinite(np.sum(np.asarray(m["loss_sum"])))
+
+
 def test_wus_with_sgd_momentum(cpu_devices):
     """The flat-shard update is optimizer-agnostic: SGD+momentum's buffer
     shards the same way and matches the replicated trajectory."""

@@ -744,9 +744,11 @@ class PreparedOptimizer:
                 if self._queue and (
                     self._queue[0][3] is not criterion
                     # ragged stream (e.g. a raw smaller last batch from an
-                    # unprepared loader): never stack mixed shapes — flush
-                    # the homogeneous prefix first
+                    # unprepared loader): never stack mixed shapes/dtypes —
+                    # flush the homogeneous prefix first (jnp.stack would
+                    # silently promote a mixed-dtype stack)
                     or self._queue[0][0].shape != xb.shape
+                    or self._queue[0][0].dtype != xb.dtype
                 ):
                     self.flush()
                 self._queue.append((xb, yb, wb, criterion, step_idx, lazy_loss))

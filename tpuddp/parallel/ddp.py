@@ -137,27 +137,29 @@ class DistributedDataParallel:
         state = col.broadcast_one_to_all(state)
         if not self.weight_update_sharding:
             return replicate(self.mesh, state)
-        # placement: everything replicated EXCEPT the (total,)-sized
-        # optimizer vectors, which shard over the data axis
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        # placement follows sharded_state_spec's judgment leaf by leaf (ONE
+        # predicate for what shards): optimizer vectors land sharded over the
+        # data axis, everything else replicated
+        from jax.sharding import NamedSharding
 
-        total = self._wus_spec.total
-        sharded = NamedSharding(self.mesh, P(step_lib.DATA_AXIS))
-
-        def place_opt(leaf):
-            if getattr(leaf, "ndim", None) == 1 and leaf.shape[0] == total:
+        def place_opt(leaf, spec):
+            if spec == step_lib.P(step_lib.DATA_AXIS):
                 import numpy as np
 
                 host = np.asarray(leaf)
                 return jax.make_array_from_callback(
-                    (total,), sharded, lambda idx: host[idx]
+                    host.shape,
+                    NamedSharding(self.mesh, spec),
+                    lambda idx: host[idx],
                 )
             return replicate(self.mesh, leaf)
 
         return TrainState(
             params=replicate(self.mesh, state.params),
             model_state=replicate(self.mesh, state.model_state),
-            opt_state=jax.tree_util.tree_map(place_opt, state.opt_state),
+            opt_state=jax.tree_util.tree_map(
+                place_opt, state.opt_state, self._state_spec.opt_state
+            ),
             step=replicate(self.mesh, state.step),
             rng=replicate(self.mesh, state.rng),
         )
