@@ -348,6 +348,75 @@ class FusedEvaluator:
         return float(sums[0]), int(sums[1]), int(sums[2])
 
 
+class _FlatShardedUpdate(optim_lib.Optimizer):
+    """GSPMD weight-update sharding for the managed path (the jit/auto analog
+    of the shard_map path's explicit reduce-scatter/all-gather —
+    arxiv.org/abs/2004.13336, ZeRO-1): presents the wrapped optimizer's
+    tree-pytree API while storing its state as ONE flat padded f32 vector
+    whose sharding is constrained over the data axis. Under ``jit``, XLA's
+    partitioner then computes each parameter-shard's update on the chip that
+    owns the moment shard — lowering the gradient reduction into a
+    reduce-scatter and the parameter re-replication into an all-gather —
+    without any explicit collective in the program."""
+
+    def __init__(self, inner, spec, mesh):
+        from tpuddp.parallel.mesh import data_sharded, replicated as rep_sharding
+
+        self.inner = inner
+        self.spec = spec
+        self.mesh = mesh
+        self._sharded = data_sharded(mesh)
+        self._replicated = rep_sharding(mesh)
+
+    def _is_vec(self, leaf) -> bool:
+        shape = getattr(leaf, "shape", None)
+        return shape is not None and len(shape) == 1 and shape[0] == self.spec.total
+
+    def init(self, params):
+        """Create the flat state ALREADY sharded: jit with per-leaf
+        out_shardings, so XLA materializes each chip's zero shard in place —
+        no full-size single-device allocation, no host round trip."""
+        def make():
+            return self.inner.init(jnp.zeros((self.spec.total,), jnp.float32))
+
+        shaped = jax.eval_shape(make)
+        out_sh = jax.tree_util.tree_map(
+            lambda l: self._sharded if self._is_vec(l) else self._replicated,
+            shaped,
+        )
+        return jax.jit(make, out_shardings=out_sh)()
+
+    def place_state(self, opt_state):
+        """Lay a HOST-side flat state (a checkpoint restore) out over the
+        mesh: (total,) vectors sharded over the data axis, scalars
+        replicated (via the multi-host-safe replicate helper)."""
+        def place(leaf):
+            if self._is_vec(leaf):
+                host = np.asarray(leaf)
+                return jax.make_array_from_callback(
+                    host.shape, self._sharded, lambda idx: host[idx]
+                )
+            return replicate(self.mesh, leaf)
+
+        return jax.tree_util.tree_map(place, opt_state)
+
+    def update(self, grads, opt_state, params):
+        from jax.lax import with_sharding_constraint as wsc
+
+        from tpuddp.training.step import _tree_to_vec, _vec_to_tree
+
+        g_vec = wsc(_tree_to_vec(grads, self.spec), self._sharded)
+        p_vec = _tree_to_vec(params, self.spec)
+        new_p_vec, new_os = self.inner.update(g_vec, opt_state, p_vec)
+        # pin the state sharded (stable layout across steps/donation) and the
+        # params replicated (the all-gather point)
+        new_os = jax.tree_util.tree_map(
+            lambda l: wsc(l, self._sharded) if self._is_vec(l) else l, new_os
+        )
+        new_p_vec = wsc(new_p_vec, self._replicated)
+        return _vec_to_tree(new_p_vec, self.spec), new_os
+
+
 def _resolve_auto_fuse(params) -> int:
     """The managed size-aware fusion depth: 32 for dispatch-bound small
     models (whole parameter set under ~4 MB), 8 otherwise — the
@@ -696,6 +765,25 @@ class PreparedOptimizer:
         self.model._pending_grads = None
         self.model._pending = None
 
+    def _ensure_opt_state(self):
+        """Lazy optimizer-state init. Under
+        ``Accelerator(weight_update_sharding=True)`` the optimizer is wrapped
+        in :class:`_FlatShardedUpdate` first, so the moments are created flat
+        and laid out SHARDED over the data axis."""
+        if self.opt_state is not None:
+            return
+        model = self.model
+        acc = model.accelerator
+        if getattr(acc, "weight_update_sharding", False):
+            if not isinstance(self.optimizer, _FlatShardedUpdate):
+                from tpuddp.training.step import make_flat_param_spec
+
+                spec = make_flat_param_spec(model.params, acc.mesh.devices.size)
+                self.optimizer = _FlatShardedUpdate(self.optimizer, spec, acc.mesh)
+            self.opt_state = self.optimizer.init(model.params)  # born sharded
+        else:
+            self.opt_state = self.optimizer.init(model.params)
+
     def step(self):
         model = self.model
         model._check_not_lost()
@@ -703,8 +791,7 @@ class PreparedOptimizer:
             raise RuntimeError(
                 "optimizer.step() called without a preceding accelerator.backward(loss)"
             )
-        if self.opt_state is None:
-            self.opt_state = self.optimizer.init(model.params)
+        self._ensure_opt_state()
         if model._pending is not None:
             x, y, w, criterion, step_idx, lazy_loss = model._pending
             model._pending = None
@@ -931,6 +1018,7 @@ class Accelerator:
         num_chips: Optional[int] = None,
         clip_grad_norm: Optional[float] = None,
         gradient_accumulation_steps: int = 1,
+        weight_update_sharding: bool = False,
     ):
         """``fuse_steps``: K > 1 batches per-step calls into one compiled
         lax.scan dispatch (the managed analog of the native scan fusion) —
@@ -946,7 +1034,13 @@ class Accelerator:
         ``num_chips``: restrict the data mesh to the first N local devices
         (the managed analog of ``local.tpu.num_chips`` — without it a
         configured sub-world would be silently ignored on multi-chip hosts).
-        Ignored when an explicit ``mesh`` is passed."""
+        Ignored when an explicit ``mesh`` is passed.
+
+        ``weight_update_sharding``: ZeRO-1 on the managed path — Adam moments
+        live as a flat vector SHARDED over the data axis and each chip
+        computes only its parameter shard's update (XLA lowers the exchange
+        to reduce-scatter + all-gather via sharding constraints; see
+        :class:`_FlatShardedUpdate` and arxiv.org/abs/2004.13336)."""
         self.mesh = mesh if mesh is not None else data_mesh(num_chips)
         key, _ = seeding.set_seed_based_on_rank(base_seed=seed)
         self._key = key
@@ -966,6 +1060,7 @@ class Accelerator:
         # steps (zero_grad stays safe to call every batch, as HF's managed
         # no-op semantics allow; the boundary step clears the accumulator).
         self.gradient_accumulation_steps = max(1, int(gradient_accumulation_steps))
+        self.weight_update_sharding = bool(weight_update_sharding)
         if self.gradient_accumulation_steps > 1:
             if self.fuse_steps == "auto":
                 # accumulation owns the step cadence; auto-fusion yields
@@ -1151,10 +1246,10 @@ class Accelerator:
         """Template tree for the lossless managed state: weights + buffers +
         optimizer moments + the RNG stream position (accelerator key, backward
         base key, backward counter)."""
-        if optimizer.opt_state is None:
-            # zeros template so a never-stepped (or weights-only-restored)
-            # run still has the structure to save/load into
-            optimizer.opt_state = optimizer.optimizer.init(model._params)
+        # zeros template so a never-stepped (or weights-only-restored) run
+        # still has the structure to save/load into; under
+        # weight_update_sharding this also establishes the flat sharded layout
+        optimizer._ensure_opt_state()
         return {
             "params": model._params,
             "model_state": model._model_state,
@@ -1191,10 +1286,9 @@ class Accelerator:
                 "first (the entrypoint's epoch boundary does)"
             )
         tree = self._full_state_like(model, optimizer)
-        if self.is_main_process:
-            os.makedirs(save_dir, exist_ok=True)
-            ckpt.save(ckpt.checkpoint_path(save_dir, epoch, prefix="state"), tree)
-        col.barrier("tpuddp_accelerate_save_state")
+        # one writer discipline for every checkpoint flavor: cross-host
+        # gather (collective) -> process-0 write -> barrier
+        ckpt.save_on_main(save_dir, epoch, tree, prefix="state")
 
     def load_state(
         self, model: PreparedModel, optimizer: "PreparedOptimizer", save_dir: str
@@ -1225,10 +1319,16 @@ class Accelerator:
         path, epoch = found
         restored = ckpt.load(path, like)
         next_epoch = epoch + 1
-        model._params, model._model_state, optimizer.opt_state = replicate(
-            self.mesh,
-            (restored["params"], restored["model_state"], restored["opt_state"]),
+        model._params, model._model_state = replicate(
+            self.mesh, (restored["params"], restored["model_state"])
         )
+        if isinstance(optimizer.optimizer, _FlatShardedUpdate):
+            # flat sharded layout: moments go back SHARDED, not replicated
+            optimizer.opt_state = optimizer.optimizer.place_state(
+                restored["opt_state"]
+            )
+        else:
+            optimizer.opt_state = replicate(self.mesh, restored["opt_state"])
         self._key = restored["rng_key"]
         model._bwd_key = restored["bwd_key"]
         model._bwd_counter = int(restored["bwd_counter"])

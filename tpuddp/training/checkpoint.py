@@ -145,15 +145,19 @@ def _gather_cross_host_shards(tree: Any) -> Any:
     return jax.tree_util.tree_map(g, tree)
 
 
-def save_on_main(save_dir: str, epoch: int, tree: Any) -> Optional[str]:
+def save_on_main(
+    save_dir: str, epoch: int, tree: Any, prefix: str = "ckpt"
+) -> Optional[str]:
     """Process-0-only save + barrier — the reference's writer discipline
-    (:217-223). Returns the path on process 0, None elsewhere."""
+    (:217-223), with the cross-host shard gather (a collective) BEFORE the
+    process-0 gate. Returns the path on process 0, None elsewhere. The
+    managed full-state files use ``prefix="state"``."""
     if jax.process_count() > 1:
         tree = _gather_cross_host_shards(tree)
     path = None
     if jax.process_index() == 0:
         os.makedirs(save_dir, exist_ok=True)
-        path = save(checkpoint_path(save_dir, epoch), tree)
+        path = save(checkpoint_path(save_dir, epoch, prefix), tree)
     col.barrier("tpuddp_checkpoint")
     return path
 

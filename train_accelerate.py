@@ -215,6 +215,7 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         num_chips=num_chips,
         clip_grad_norm=training.get("clip_grad_norm"),
         gradient_accumulation_steps=accum,
+        weight_update_sharding=bool(training.get("weight_update_sharding", False)),
     )
 
     # Data + model (reference :118-122); placement is implicit on this path.
