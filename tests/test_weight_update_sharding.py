@@ -312,3 +312,28 @@ def test_managed_wus_save_state_round_trip(cpu_devices, tmp_path):
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
         model2.params, expect,
     )
+
+
+def test_native_wus_compiles_to_reduce_scatter_all_gather(cpu_devices):
+    """The exchange IS the claimed one: the compiled HLO of the native
+    weight-update-sharded step carries the gradient reduction as a
+    reduce-scatter and re-replicates parameters with one all-gather — no
+    full-gradient all-reduce remains."""
+    from tpuddp.training import step as step_lib
+
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    ddp = build(mesh, True)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    # same step configuration as the product path, specs via the public API
+    spec = make_flat_param_spec(st.params, world=8)
+    opt_template = ddp.optimizer.init(jnp.zeros((spec.total,), jnp.float32))
+    sspec = step_lib.sharded_state_spec(opt_template, spec)
+    fn = step_lib.build_train_step(
+        ddp.model, ddp.criterion, ddp.optimizer, mesh, mode="shard_map",
+        wus_spec=spec, state_spec=sspec,
+    )
+    txt = jax.jit(fn).lower(st, ddp.shard((x, y, w))).compile().as_text()
+    assert txt.count("reduce-scatter") >= 1
+    assert txt.count("all-gather") >= 1
+    assert txt.count("all-reduce") == 0  # the full-grad allreduce is GONE

@@ -355,9 +355,15 @@ class _FlatShardedUpdate(optim_lib.Optimizer):
     tree-pytree API while storing its state as ONE flat padded f32 vector
     whose sharding is constrained over the data axis. Under ``jit``, XLA's
     partitioner then computes each parameter-shard's update on the chip that
-    owns the moment shard — lowering the gradient reduction into a
-    reduce-scatter and the parameter re-replication into an all-gather —
-    without any explicit collective in the program."""
+    owns the moment shard, without any explicit collective in the program.
+    The sharded STORAGE and partitioned update math are guaranteed (layout
+    asserted in tests); the concrete collective the partitioner derives for
+    the gradient exchange is backend-dependent (the TPU partitioner forms
+    reduce-scatter for this pattern; the CPU test backend emits
+    all-reduce + gather). The native shard_map path spells the
+    reduce-scatter/all-gather out explicitly — and its compiled HLO is
+    asserted to contain exactly that exchange
+    (tests/test_weight_update_sharding.py)."""
 
     def __init__(self, inner, spec, mesh):
         from tpuddp.parallel.mesh import data_sharded, replicated as rep_sharding
