@@ -176,3 +176,16 @@ def test_rendezvous_multiprocess_requires_coordinator_on_cpu(monkeypatch):
         {"local": {"device": "tpu", "rendezvous": {"num_processes": 2}}}
     )
     assert out == {"num_processes": 2}
+
+
+def test_training_config_refuses_unknown_keys():
+    """A typo'd training knob must fail loudly with a did-you-mean, not be
+    silently ignored (which would train a different config than the file
+    says)."""
+    with pytest.raises(ValueError, match="wieght_update_sharding.*did you mean.*weight_update_sharding"):
+        cfg.training_config({"training": {"wieght_update_sharding": True}})
+    with pytest.raises(ValueError, match="unknown training key"):
+        cfg.training_config({"training": {"zzz_not_a_knob": 1}})
+    # every documented key still passes
+    ok = cfg.training_config({"training": {"resume": True, "synthetic_n": [64, 32]}})
+    assert ok["resume"] is True and ok["synthetic_n"] == [64, 32]

@@ -52,8 +52,13 @@ TRAINING_DEFAULTS = {
     "gradient_accumulation_steps": 1,  # managed path: averaged update every N steps
     "optimizer_state_dtype": None,  # Adam m/v storage dtype ("bfloat16" halves
     # optimizer HBM traffic; math stays f32). None -> params' dtype.
-    "pretrained_path": None,  # torch checkpoint to fine-tune from (alexnet | resnet18)
+    "pretrained_path": None,  # torch checkpoint to fine-tune from
+    # (alexnet | resnet18 | resnet34)
     "num_classes": None,  # None -> derived from training.dataset
+    "resume": False,  # restore the newest checkpoint from out_dir (native:
+    # ckpt_{epoch}.npz full TrainState; managed: state_{epoch}.npz)
+    "synthetic_n": None,  # (train, test) sizes for the synthetic dataset /
+    # fallback; None -> (2048, 512)
 }
 
 # Label-space size by dataset name; the reference hardcodes 10 because its only
@@ -184,6 +189,23 @@ def optional_args_from(settings: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def training_config(settings: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge the settings file's ``training`` block over the defaults.
+    Unknown keys are REFUSED with a did-you-mean hint — a typo'd knob
+    (``wieght_update_sharding``) silently ignored would train a different
+    configuration than the file says."""
     cfg = dict(TRAINING_DEFAULTS)
-    cfg.update(settings.get("training") or {})
+    overrides = settings.get("training") or {}
+    unknown = set(overrides) - set(TRAINING_DEFAULTS)
+    if unknown:
+        import difflib
+
+        hints = []
+        for k in sorted(unknown):
+            close = difflib.get_close_matches(k, TRAINING_DEFAULTS, n=1)
+            hints.append(f"{k!r}" + (f" (did you mean {close[0]!r}?)" if close else ""))
+        raise ValueError(
+            f"unknown training key(s): {', '.join(hints)}. Known keys: "
+            f"{sorted(TRAINING_DEFAULTS)}"
+        )
+    cfg.update(overrides)
     return cfg
