@@ -67,41 +67,48 @@ def test_loss_curve_parity_vs_torch_ddp(tmp_path, cpu_devices):
             return self.images[i], self.labels[i]
 
     mesh = make_mesh(cpu_devices[:2])
-    model = tnn.Sequential(
-        tnn.Linear(256), tnn.ReLU(), tnn.Linear(128), tnn.ReLU(), tnn.Linear(10)
-    )
-    ddp = DistributedDataParallel(
-        model, optim.Adam(LR), tnn.CrossEntropyLoss(), mesh=mesh
-    )
-    state = ddp.init_state(jax.random.key(0), jnp.zeros((1, FEATURES)))
-
-    # graft the torch run's initial weights (Linear: (out,in) -> (in,out))
     sd = torch.load(str(out_path) + ".init.pt", weights_only=True)
-    params = list(state.params)
-    for layer_idx, torch_idx in [(0, 0), (2, 2), (4, 4)]:
-        params[layer_idx] = {
-            "weight": jnp.asarray(sd[f"{torch_idx}.weight"].numpy().T),
-            "bias": jnp.asarray(sd[f"{torch_idx}.bias"].numpy()),
-        }
-    state = state.__class__(
-        params=tuple(params),
-        model_state=state.model_state,
-        opt_state=state.opt_state,
-        step=state.step,
-        rng=state.rng,
-    )
 
-    loader = ShardedDataLoader(ArrayDataset(x, labels), BATCH, mesh, shuffle=False)
-    ours_curve = []
-    for _ in range(EPOCHS):
-        acc = None
-        for host_batch in loader:
-            state, m = ddp.train_step(state, ddp.shard(host_batch))
-            acc = accumulate_metrics(acc, m)
-        final = finalize_metrics(acc)
-        ours_curve.append(final["loss_sum"] / final["n"])
+    def tpuddp_curve(weight_update_sharding: bool):
+        model = tnn.Sequential(
+            tnn.Linear(256), tnn.ReLU(), tnn.Linear(128), tnn.ReLU(), tnn.Linear(10)
+        )
+        ddp = DistributedDataParallel(
+            model, optim.Adam(LR), tnn.CrossEntropyLoss(), mesh=mesh,
+            weight_update_sharding=weight_update_sharding,
+        )
+        state = ddp.init_state(jax.random.key(0), jnp.zeros((1, FEATURES)))
 
-    # the north star: loss-curve parity with the reference's DDP baseline
-    np.testing.assert_allclose(ours_curve, torch_curve, rtol=2e-3)
-    # and the model actually learned
-    assert ours_curve[-1] < ours_curve[0] * 0.7
+        # graft the torch run's initial weights (Linear: (out,in) -> (in,out))
+        params = list(state.params)
+        for layer_idx, torch_idx in [(0, 0), (2, 2), (4, 4)]:
+            params[layer_idx] = {
+                "weight": jnp.asarray(sd[f"{torch_idx}.weight"].numpy().T),
+                "bias": jnp.asarray(sd[f"{torch_idx}.bias"].numpy()),
+            }
+        state = state.__class__(
+            params=tuple(params),
+            model_state=state.model_state,
+            opt_state=state.opt_state,
+            step=state.step,
+            rng=state.rng,
+        )
+
+        loader = ShardedDataLoader(ArrayDataset(x, labels), BATCH, mesh, shuffle=False)
+        curve = []
+        for _ in range(EPOCHS):
+            acc = None
+            for host_batch in loader:
+                state, m = ddp.train_step(state, ddp.shard(host_batch))
+                acc = accumulate_metrics(acc, m)
+            final = finalize_metrics(acc)
+            curve.append(final["loss_sum"] / final["n"])
+        return curve
+
+    # the north star: loss-curve parity with the reference's DDP baseline —
+    # for BOTH optimizer layouts (replicated update AND weight-update-sharded)
+    for wus in (False, True):
+        ours_curve = tpuddp_curve(wus)
+        np.testing.assert_allclose(ours_curve, torch_curve, rtol=2e-3)
+        # and the model actually learned
+        assert ours_curve[-1] < ours_curve[0] * 0.7
