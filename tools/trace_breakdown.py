@@ -37,7 +37,7 @@ def load_ops(trace_dir: str):
         if e.get("ph") == "M" and e.get("name") == "process_name":
             if "TPU" in e["args"].get("name", ""):
                 device_pids.add(e["pid"])
-    return [
+    ops = [
         e
         for e in events
         if e.get("ph") == "X"
@@ -45,6 +45,16 @@ def load_ops(trace_dir: str):
         and tids.get((e["pid"], e["tid"])) == "XLA Ops"
         and not e["name"].startswith("while")
     ]
+    if not ops and len(events) >= 900_000:
+        # the trace-viewer JSON export caps around 1M events; a long epoch's
+        # host python spans crowd every device op out of the file
+        raise SystemExit(
+            f"trace has {len(events)} events but zero device 'XLA Ops' — the "
+            "exporter's ~1M-event cap was likely hit and host events crowded "
+            "the device rows out. Capture a SHORTER window (fewer steps, e.g. "
+            "training.synthetic_n: [2048, 256]) and re-run."
+        )
+    return ops
 
 
 import re
