@@ -478,6 +478,14 @@ def main():
         # own b128 (profile-backed; see BASELINE.md "Where the time goes")
         ("alexnet bf16 224 bf16-opt (scan-fused)", bf16_alexnet, 128, 16, 96,
          bf16_opt),
+        # exact space-to-depth stem reparameterization (model: alexnet_s2d):
+        # the 11x11/s4 3-channel stem becomes a unit-stride conv over 48
+        # blocked channels — same math/params, ~+2.5 MFU points at the
+        # reference-constant b128 (amortized away at b512)
+        ("alexnet bf16 224 bf16-opt s2d (scan-fused)",
+         lambda: (AlexNet(10, space_to_depth=True),
+                  make_train_augment(size=224, compute_dtype=jnp.bfloat16)),
+         128, 16, 96, bf16_opt),
         # the TPU-right batch: amortizes the remaining fixed per-step
         # param+grad HBM traffic over 4x the samples
         ("alexnet bf16 224 b512 bf16-opt (scan-fused)", bf16_alexnet, 512, 4,

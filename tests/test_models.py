@@ -138,3 +138,71 @@ def test_resnet34_registry_and_sync_bn():
 
     m = load_model("resnet34_small", 10, sync_bn=True)
     assert not has_divergent_buffers(m)  # every BN is synced
+
+
+def test_space_to_depth_stem_is_exact():
+    """nn.SpaceToDepthConv2d == nn.Conv2d bit-for-reassociation: same params,
+    same forward output and same parameter gradients on the AlexNet stem
+    shape (11x11/s4/p2 on 3 channels), plus a non-square odd-size case."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuddp import nn
+    from tpuddp.nn.core import Context
+
+    for (h, w), k, s, p in [((224, 224), 11, 4, 2), ((67, 93), 7, 2, 3)]:
+        ref = nn.Conv2d(16, kernel_size=k, strides=s, padding=p)
+        s2d = nn.SpaceToDepthConv2d(16, kernel_size=k, strides=s, padding=p)
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(2, h, w, 3).astype(np.float32)
+        )
+        params, _ = ref.init(jax.random.key(0), x)
+
+        y_ref, _ = ref.apply(params, (), x, Context())
+        y_s2d, _ = s2d.apply(params, (), x, Context())
+        assert y_ref.shape == y_s2d.shape
+        np.testing.assert_allclose(
+            np.asarray(y_ref), np.asarray(y_s2d), rtol=1e-5, atol=1e-5
+        )
+
+        def loss(mod):
+            def f(p):
+                y, _ = mod.apply(p, (), x, Context())
+                return jnp.sum(y * y)
+            return jax.grad(f)(params)
+
+        g_ref, g_s2d = loss(ref), loss(s2d)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4
+            ),
+            g_ref, g_s2d,
+        )
+
+
+def test_alexnet_s2d_same_logits_and_registry():
+    """AlexNet(space_to_depth=True) shares parameter trees with the vanilla
+    model (checkpoints/imports interchangeable) and produces the same
+    logits; the registry exposes it as alexnet_s2d."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuddp.models import AlexNet, load_model
+    from tpuddp.nn.core import Context
+
+    vanilla = AlexNet(num_classes=10)
+    s2d = load_model("alexnet_s2d", 10)
+    x = jnp.asarray(
+        np.random.RandomState(1).randn(2, 224, 224, 3).astype(np.float32)
+    )
+    params, state = vanilla.init(jax.random.key(0), x)
+    p2, _ = s2d.init(jax.random.key(0), x)
+    jax.tree_util.tree_map(  # identical tree structure AND shapes
+        lambda a, b: (np.testing.assert_array_equal(np.shape(a), np.shape(b))),
+        params, p2,
+    )
+    y1, _ = vanilla.apply(params, state, x, Context(train=False))
+    y2, _ = s2d.apply(params, state, x, Context(train=False))
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-4, atol=1e-4)

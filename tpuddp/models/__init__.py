@@ -18,6 +18,9 @@ _REGISTRY = {
     # CIFAR-style stem (3x3 conv, no maxpool) for small native resolutions
     "resnet18_small": _partial(ResNet18, small_input=True),
     "resnet34_small": _partial(ResNet34, small_input=True),
+    # exact space-to-depth stem reparameterization (same params/checkpoints;
+    # faster MXU mapping for the 11x11/s4 3-channel stem)
+    "alexnet_s2d": _partial(AlexNet, space_to_depth=True),
 }
 
 

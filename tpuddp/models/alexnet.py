@@ -15,12 +15,20 @@ import jax
 from tpuddp import nn
 
 
-def AlexNet(num_classes: int = 10, dropout: float = 0.5) -> nn.Sequential:
+def AlexNet(
+    num_classes: int = 10, dropout: float = 0.5, space_to_depth: bool = False
+) -> nn.Sequential:
     """torchvision AlexNet topology: 5 conv blocks -> adaptive 6x6 avg pool ->
     3-layer classifier. Input is NHWC, any spatial size >= 63 (reference feeds
-    224x224 CIFAR upsamples)."""
+    224x224 CIFAR upsamples).
+
+    ``space_to_depth=True`` swaps the 11x11/s4 3-channel stem for its exact
+    space-to-depth reparameterization (nn.SpaceToDepthConv2d) — same math,
+    same parameter shapes (checkpoints/torch imports interchangeable), far
+    better MXU utilization on the thin-channel strided stem."""
+    stem_cls = nn.SpaceToDepthConv2d if space_to_depth else nn.Conv2d
     features = [
-        nn.Conv2d(64, kernel_size=11, strides=4, padding=2),
+        stem_cls(64, kernel_size=11, strides=4, padding=2),
         nn.ReLU(),
         nn.MaxPool2d(3, strides=2),
         nn.Conv2d(192, kernel_size=5, padding=2),

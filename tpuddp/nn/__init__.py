@@ -11,6 +11,7 @@ from tpuddp.nn.layers import (  # noqa: F401
     AdaptiveAvgPool2d,
     AvgPool2d,
     Conv2d,
+    SpaceToDepthConv2d,
     Dropout,
     Flatten,
     Linear,
@@ -26,6 +27,7 @@ __all__ = [
     "Sequential",
     "Linear",
     "Conv2d",
+    "SpaceToDepthConv2d",
     "MaxPool2d",
     "AvgPool2d",
     "AdaptiveAvgPool2d",

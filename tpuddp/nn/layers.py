@@ -120,6 +120,75 @@ class Conv2d(Module):
         return False  # parameters only, no buffers
 
 
+class SpaceToDepthConv2d(Conv2d):
+    """Exact reparameterization of a strided conv as space-to-depth + a
+    unit-stride conv — the classic TPU recipe for thin-channel strided stems
+    (MLPerf ResNet's conv1 trick, here for AlexNet's 11x11/s4 3-channel
+    stem): the original form contracts only ``C*kw`` values per MXU pass and
+    its backward needs strided grad-convolutions; the blocked form contracts
+    ``s*s*C`` channels per tap at stride 1.
+
+    Mathematically identical to :class:`Conv2d` (same sum, re-associated):
+    the input is blocked ``(H, W, C) -> (H/s, W/s, s*s*C)`` and the kernel is
+    zero-padded to an ``s`` multiple and reshaped to match. Parameters keep
+    the ORIGINAL ``(kh, kw, C, F)`` layout — torch imports, checkpoints, and
+    init are interchangeable with ``Conv2d``; the blocked weight view is a
+    tiny reshape XLA fuses into the conv. Requires square integer stride
+    (= the block size) and integer symmetric padding."""
+
+    def __init__(self, features, kernel_size, strides, padding=0, use_bias=True, dtype=jnp.float32):
+        super().__init__(features, kernel_size, strides, padding, use_bias, dtype)
+        if self.strides[0] != self.strides[1] or self.strides[0] < 2:
+            raise ValueError(
+                f"SpaceToDepthConv2d needs a square stride >= 2 (the block "
+                f"size); got {self.strides}"
+            )
+        if not isinstance(padding, int):
+            raise ValueError(
+                "SpaceToDepthConv2d supports integer (symmetric) padding only"
+            )
+
+    def apply(self, params, state, x, ctx: Context):
+        s = self.strides[0]
+        kh, kw = self.kernel_size
+        p = self.padding
+        n, h, w, c = x.shape
+        oh = (h + 2 * p - kh) // s + 1
+        ow = (w + 2 * p - kw) // s + 1
+        kbh, kbw = -(-kh // s), -(-kw // s)  # ceil
+        # pre-pad so every window start (s*i - p) + p is block-aligned, with
+        # enough right/bottom slack for the last window and an s multiple
+        def pads(dim, o, k):
+            right = max(p, s * (o - 1) + k - dim - p)
+            total = dim + p + right
+            right += (-total) % s
+            return (p, right)
+
+        ph, pw = pads(h, oh, kbh * s), pads(w, ow, kbw * s)
+        xp = jnp.pad(x, ((0, 0), ph, pw, (0, 0)))
+        bh, bw = xp.shape[1] // s, xp.shape[2] // s
+        xb = (
+            xp.reshape(n, bh, s, bw, s, c)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(n, bh, bw, s * s * c)
+        )
+        wk = params["weight"].astype(x.dtype)
+        wk = jnp.pad(wk, ((0, kbh * s - kh), (0, kbw * s - kw), (0, 0), (0, 0)))
+        wb = (
+            wk.reshape(kbh, s, kbw, s, c, self.features)
+            .transpose(0, 2, 1, 3, 4, 5)
+            .reshape(kbh, kbw, s * s * c, self.features)
+        )
+        y = lax.conv_general_dilated(
+            xb, wb, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = y[:, :oh, :ow, :]
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y, state
+
+
 class _Pool2d(Module):
     def __init__(self, window: IntOr2, strides: Optional[IntOr2] = None, padding: Union[str, int] = 0):
         self.window = _pair(window)
