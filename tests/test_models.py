@@ -206,3 +206,33 @@ def test_alexnet_s2d_same_logits_and_registry():
     y1, _ = vanilla.apply(params, state, x, Context(train=False))
     y2, _ = s2d.apply(params, state, x, Context(train=False))
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_s2d_stem_same_logits():
+    """ResNet's 7x7/s2 full stem under space_to_depth: same params, same
+    eval-mode logits as the plain stem (exactness at the model level)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuddp.models import ResNet18, load_model
+    from tpuddp.nn.core import Context
+
+    plain = ResNet18(num_classes=10)
+    s2d = load_model("resnet18_s2d", 10)
+    x = jnp.asarray(
+        np.random.RandomState(2).randn(2, 96, 96, 3).astype(np.float32)
+    )
+    params, state = plain.init(jax.random.key(0), x)
+    y1, _ = plain.apply(params, state, x, Context(train=False))
+    y2, _ = s2d.apply(params, state, x, Context(train=False))
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-4, atol=1e-4)
+
+
+def test_small_input_rejects_space_to_depth():
+    import pytest
+
+    from tpuddp.models import ResNet18
+
+    with pytest.raises(ValueError, match="small_input"):
+        ResNet18(small_input=True, space_to_depth=True)

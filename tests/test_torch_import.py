@@ -289,3 +289,26 @@ def test_resnet34_import_rejects_resnet18_checkpoint(tmp_path):
     params, mstate = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
     with pytest.raises((ValueError, KeyError)):
         convert_resnet34_state_dict(donor.state_dict(), params, mstate)
+
+
+def test_pretrained_s2d_variants_load_same_checkpoint(tmp_path):
+    """The _s2d model names accept the same torch checkpoints (identical
+    parameter layout) and produce the same logits as the plain import."""
+    from tpuddp.models.torch_import import pretrained_from_config
+    from tpuddp.nn.core import Context
+
+    torch.manual_seed(10)
+    donor = _TorchResNet18(num_classes=1000)
+    path = tmp_path / "donor18.pt"
+    torch.save(donor.state_dict(), str(path))
+    cfgs = [
+        dict(model=m, pretrained_path=str(path), seed=0, num_classes=10, image_size=64)
+        for m in ("resnet18", "resnet18_s2d")
+    ]
+    out = []
+    for c in cfgs:
+        model, params, mstate = pretrained_from_config(c)
+        x = np.random.RandomState(3).randn(2, 64, 64, 3).astype(np.float32)
+        y, _ = model.apply(params, mstate, jnp.asarray(x), Context(train=False))
+        out.append(np.asarray(y))
+    np.testing.assert_allclose(out[0], out[1], rtol=1e-4, atol=1e-4)

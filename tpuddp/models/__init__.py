@@ -19,8 +19,10 @@ _REGISTRY = {
     "resnet18_small": _partial(ResNet18, small_input=True),
     "resnet34_small": _partial(ResNet34, small_input=True),
     # exact space-to-depth stem reparameterization (same params/checkpoints;
-    # faster MXU mapping for the 11x11/s4 3-channel stem)
+    # faster MXU mapping for the thin-channel strided stems)
     "alexnet_s2d": _partial(AlexNet, space_to_depth=True),
+    "resnet18_s2d": _partial(ResNet18, space_to_depth=True),
+    "resnet34_s2d": _partial(ResNet34, space_to_depth=True),
 }
 
 

@@ -70,20 +70,29 @@ def _resnet(
     num_classes: int,
     sync_bn: bool,
     small_input: bool,
+    space_to_depth: bool = False,
 ) -> nn.Sequential:
     """stem + BasicBlock stages at widths [64,128,256,512] + GAP head.
     ``small_input=True`` uses the CIFAR stem (3x3/1 conv, no maxpool) for
     native 32x32 training — the TPU-friendly alternative to the reference's
-    resize-everything-to-224."""
+    resize-everything-to-224. ``space_to_depth=True`` swaps the full stem's
+    7x7/s2 3-channel conv for its exact space-to-depth reparameterization
+    (same parameters/checkpoints; see nn.SpaceToDepthConv2d)."""
     if small_input:
+        if space_to_depth:
+            raise ValueError(
+                "space_to_depth applies to the full 7x7/s2 stem; the "
+                "small_input CIFAR stem (3x3/s1) has no stride to block"
+            )
         stem = [
             nn.Conv2d(64, 3, strides=1, padding=1, use_bias=False),
             nn.BatchNorm(sync=sync_bn),
             nn.ReLU(),
         ]
     else:
+        stem_cls = nn.SpaceToDepthConv2d if space_to_depth else nn.Conv2d
         stem = [
-            nn.Conv2d(64, 7, strides=2, padding=3, use_bias=False),
+            stem_cls(64, 7, strides=2, padding=3, use_bias=False),
             nn.BatchNorm(sync=sync_bn),
             nn.ReLU(),
             nn.MaxPool2d(3, strides=2, padding=1),
@@ -102,14 +111,16 @@ def _resnet(
 
 
 def ResNet18(
-    num_classes: int = 10, sync_bn: bool = False, small_input: bool = False
+    num_classes: int = 10, sync_bn: bool = False, small_input: bool = False,
+    space_to_depth: bool = False,
 ) -> nn.Sequential:
     """Standard ResNet-18: [2,2,2,2] BasicBlocks."""
-    return _resnet((2, 2, 2, 2), num_classes, sync_bn, small_input)
+    return _resnet((2, 2, 2, 2), num_classes, sync_bn, small_input, space_to_depth)
 
 
 def ResNet34(
-    num_classes: int = 10, sync_bn: bool = False, small_input: bool = False
+    num_classes: int = 10, sync_bn: bool = False, small_input: bool = False,
+    space_to_depth: bool = False,
 ) -> nn.Sequential:
     """Standard ResNet-34: [3,4,6,3] BasicBlocks."""
-    return _resnet((3, 4, 6, 3), num_classes, sync_bn, small_input)
+    return _resnet((3, 4, 6, 3), num_classes, sync_bn, small_input, space_to_depth)

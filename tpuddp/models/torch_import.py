@@ -18,6 +18,7 @@ proof that the architectures are identical.
 
 from __future__ import annotations
 
+from functools import partial as _pt
 from typing import Dict, Mapping
 
 import jax.numpy as jnp
@@ -98,7 +99,8 @@ def load_torch_alexnet(params, path: str):
 
 
 def load_pretrained_alexnet(
-    path: str, key, num_classes: int = 10, image_size: int = 224
+    path: str, key, num_classes: int = 10, image_size: int = 224,
+    space_to_depth: bool = False,
 ):
     """The reference's fine-tune-from-pretrained workflow
     (data_and_toy_model.py:41-45), from a torch checkpoint on disk: build an
@@ -106,12 +108,14 @@ def load_pretrained_alexnet(
     import the weights, then swap in a fresh ``num_classes`` head when the
     widths differ. Returns ``(model, params, model_state)`` ready for
     ``DistributedDataParallel.init_state`` / ``Accelerator.prepare``.
+    ``space_to_depth`` builds the s2d-stem variant — the parameter layout is
+    identical, so the same checkpoint loads either way.
     """
     from tpuddp.models.alexnet import AlexNet
 
     return _load_pretrained(
         path, key, num_classes, image_size,
-        build=lambda n: AlexNet(num_classes=n),
+        build=lambda n: AlexNet(num_classes=n, space_to_depth=space_to_depth),
         head_weight_key="classifier.6.weight",
         convert=lambda sd, p, s: (convert_alexnet_state_dict(sd, p), s),
         salt=0x9e7,
@@ -269,7 +273,10 @@ def convert_resnet34_state_dict(state_dict: Mapping[str, object], params, model_
     )
 
 
-def load_pretrained_resnet18(path: str, key, num_classes: int = 10, image_size: int = 224):
+def load_pretrained_resnet18(
+    path: str, key, num_classes: int = 10, image_size: int = 224,
+    space_to_depth: bool = False,
+):
     """ResNet-18 analog of :func:`load_pretrained_alexnet`: build the model
     sized to the checkpoint's own head, import weights + BN statistics, swap
     in a fresh ``num_classes`` head when the widths differ."""
@@ -277,14 +284,17 @@ def load_pretrained_resnet18(path: str, key, num_classes: int = 10, image_size: 
 
     return _load_pretrained(
         path, key, num_classes, image_size,
-        build=lambda n: ResNet18(num_classes=n),
+        build=lambda n: ResNet18(num_classes=n, space_to_depth=space_to_depth),
         head_weight_key="fc.weight",
         convert=convert_resnet18_state_dict,
         salt=0x9e8,
     )
 
 
-def load_pretrained_resnet34(path: str, key, num_classes: int = 10, image_size: int = 224):
+def load_pretrained_resnet34(
+    path: str, key, num_classes: int = 10, image_size: int = 224,
+    space_to_depth: bool = False,
+):
     """ResNet-34 analog of :func:`load_pretrained_resnet18` — the [3,4,6,3]
     BasicBlock depths; wrong-depth checkpoints are rejected by the block
     consumption check (missing tensors) or leftover-tensor check."""
@@ -292,7 +302,7 @@ def load_pretrained_resnet34(path: str, key, num_classes: int = 10, image_size: 
 
     return _load_pretrained(
         path, key, num_classes, image_size,
-        build=lambda n: ResNet34(num_classes=n),
+        build=lambda n: ResNet34(num_classes=n, space_to_depth=space_to_depth),
         head_weight_key="fc.weight",
         convert=convert_resnet34_state_dict,
         salt=0x9e9,
@@ -303,6 +313,11 @@ _PRETRAINED_LOADERS = {
     "alexnet": load_pretrained_alexnet,
     "resnet18": load_pretrained_resnet18,
     "resnet34": load_pretrained_resnet34,
+    # s2d stems share the exact parameter layout, so the same torch
+    # checkpoints load into them (the "_s2d = same checkpoints" promise)
+    "alexnet_s2d": _pt(load_pretrained_alexnet, space_to_depth=True),
+    "resnet18_s2d": _pt(load_pretrained_resnet18, space_to_depth=True),
+    "resnet34_s2d": _pt(load_pretrained_resnet34, space_to_depth=True),
 }
 
 
