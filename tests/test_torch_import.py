@@ -312,3 +312,81 @@ def test_pretrained_s2d_variants_load_same_checkpoint(tmp_path):
         y, _ = model.apply(params, mstate, jnp.asarray(x), Context(train=False))
         out.append(np.asarray(y))
     np.testing.assert_allclose(out[0], out[1], rtol=1e-4, atol=1e-4)
+
+
+def _torch_vgg11(num_classes=1000):
+    """torchvision vgg11 topology in plain torch with the exact state_dict
+    key layout (torchvision is not installed)."""
+    features = tnn.Sequential(
+        tnn.Conv2d(3, 64, 3, padding=1), tnn.ReLU(inplace=True),
+        tnn.MaxPool2d(2, 2),
+        tnn.Conv2d(64, 128, 3, padding=1), tnn.ReLU(inplace=True),
+        tnn.MaxPool2d(2, 2),
+        tnn.Conv2d(128, 256, 3, padding=1), tnn.ReLU(inplace=True),
+        tnn.Conv2d(256, 256, 3, padding=1), tnn.ReLU(inplace=True),
+        tnn.MaxPool2d(2, 2),
+        tnn.Conv2d(256, 512, 3, padding=1), tnn.ReLU(inplace=True),
+        tnn.Conv2d(512, 512, 3, padding=1), tnn.ReLU(inplace=True),
+        tnn.MaxPool2d(2, 2),
+        tnn.Conv2d(512, 512, 3, padding=1), tnn.ReLU(inplace=True),
+        tnn.Conv2d(512, 512, 3, padding=1), tnn.ReLU(inplace=True),
+        tnn.MaxPool2d(2, 2),
+    )
+    classifier = tnn.Sequential(
+        tnn.Linear(512 * 7 * 7, 4096), tnn.ReLU(inplace=True), tnn.Dropout(),
+        tnn.Linear(4096, 4096), tnn.ReLU(inplace=True), tnn.Dropout(),
+        tnn.Linear(4096, num_classes),
+    )
+
+    class TorchVGG(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.features = features
+            self.avgpool = tnn.AdaptiveAvgPool2d((7, 7))
+            self.classifier = classifier
+
+        def forward(self, x):
+            x = self.features(x)
+            x = self.avgpool(x)
+            x = torch.flatten(x, 1)
+            return self.classifier(x)
+
+    return TorchVGG()
+
+
+@pytest.mark.slow
+def test_imported_vgg11_reproduces_torch_logits():
+    from tpuddp.models import VGG11
+    from tpuddp.models.torch_import import convert_vgg11_state_dict
+
+    torch.manual_seed(11)
+    donor = _torch_vgg11(num_classes=1000).eval()
+    model = VGG11(num_classes=1000)
+    params, state = model.init(jax.random.key(0), jnp.zeros((1, 224, 224, 3)))
+    params = convert_vgg11_state_dict(donor.state_dict(), params)
+    x = np.random.RandomState(4).randn(2, 224, 224, 3).astype(np.float32)
+    ours = model.apply(params, state, jnp.asarray(x), Context(train=False))[0]
+    with torch.no_grad():
+        theirs = donor(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-3, atol=1e-3)
+
+
+def test_pretrained_vgg11_head_swap_from_config(tmp_path):
+    from tpuddp.models.torch_import import pretrained_from_config
+
+    torch.manual_seed(12)
+    donor = _torch_vgg11(num_classes=1000)
+    path = tmp_path / "vgg_donor.pt"
+    torch.save(donor.state_dict(), str(path))
+    model, params, mstate = pretrained_from_config(
+        {
+            "model": "vgg11",
+            "pretrained_path": str(path),
+            "seed": 0,
+            "num_classes": 10,
+            "image_size": 64,
+        }
+    )
+    assert params[-1]["weight"].shape == (4096, 10)
+    conv0 = donor.state_dict()["features.0.weight"].numpy().transpose(2, 3, 1, 0)
+    np.testing.assert_allclose(np.asarray(params[0]["weight"]), conv0, rtol=1e-6)

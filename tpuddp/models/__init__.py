@@ -6,6 +6,7 @@ and a ResNet-18 for the multi-host BASELINE config."""
 from tpuddp.models.toy import ToyCNN, ToyMLP  # noqa: F401
 from tpuddp.models.alexnet import AlexNet  # noqa: F401
 from tpuddp.models.resnet import ResNet18, ResNet34  # noqa: F401
+from tpuddp.models.vgg import VGG11  # noqa: F401
 
 from functools import partial as _partial
 
@@ -15,6 +16,7 @@ _REGISTRY = {
     "alexnet": AlexNet,
     "resnet18": ResNet18,
     "resnet34": ResNet34,
+    "vgg11": VGG11,
     # CIFAR-style stem (3x3 conv, no maxpool) for small native resolutions
     "resnet18_small": _partial(ResNet18, small_input=True),
     "resnet34_small": _partial(ResNet34, small_input=True),
@@ -35,4 +37,7 @@ def load_model(name: str = "alexnet", num_classes: int = 10, **kwargs):
     return cls(num_classes=num_classes, **kwargs)
 
 
-__all__ = ["ToyMLP", "ToyCNN", "AlexNet", "ResNet18", "ResNet34", "load_model"]
+__all__ = [
+    "ToyMLP", "ToyCNN", "AlexNet", "ResNet18", "ResNet34", "VGG11",
+    "load_model",
+]

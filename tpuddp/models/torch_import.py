@@ -52,12 +52,23 @@ def _to_np(t) -> np.ndarray:
     return np.asarray(t, dtype=np.float32)
 
 
-def convert_alexnet_state_dict(state_dict: Mapping[str, object], params):
-    """Return a copy of tpuddp AlexNet ``params`` (tuple pytree from
-    ``AlexNet().init``) with weights replaced by the torch ``state_dict``."""
+def _convert_seq_cnn(
+    state_dict: Mapping[str, object],
+    params,
+    conv_keys: Mapping[str, int],
+    linear_keys: Mapping[str, int],
+    first_linear: str,
+    pool_grid: int,
+    pool_ch: int,
+):
+    """Shared torchvision-Sequential-CNN converter (AlexNet, VGG): conv OIHW
+    -> HWIO; the FIRST classifier Linear's flattened input axis is re-ordered
+    from torch's NCHW flatten (c, h, w) to NHWC (h, w, c); other Linears are
+    plain transposes. Every tensor's shape is validated with the torch key
+    named on mismatch."""
     new_params = list(params)
 
-    for key, idx in _CONV_KEYS.items():
+    for key, idx in conv_keys.items():
         w = _to_np(state_dict[f"{key}.weight"])  # OIHW
         b = _to_np(state_dict[f"{key}.bias"])
         hwio = np.transpose(w, (2, 3, 1, 0))
@@ -66,16 +77,16 @@ def convert_alexnet_state_dict(state_dict: Mapping[str, object], params):
             raise ValueError(f"{key}: shape {hwio.shape} != expected {expect}")
         new_params[idx] = {"weight": jnp.asarray(hwio), "bias": jnp.asarray(b)}
 
-    for key, idx in _LINEAR_KEYS.items():
+    for key, idx in linear_keys.items():
         w = _to_np(state_dict[f"{key}.weight"])  # (out, in)
         b = _to_np(state_dict[f"{key}.bias"])
-        if key == "classifier.1":
+        if key == first_linear:
             # re-order the flattened input axis: torch (c, h, w) -> ours (h, w, c)
             out_f = w.shape[0]
             w = (
-                w.reshape(out_f, _POOL_CH, _POOL_GRID, _POOL_GRID)
+                w.reshape(out_f, pool_ch, pool_grid, pool_grid)
                 .transpose(2, 3, 1, 0)  # -> (h, w, c, out)
-                .reshape(_POOL_GRID * _POOL_GRID * _POOL_CH, out_f)
+                .reshape(pool_grid * pool_grid * pool_ch, out_f)
             )
         else:
             w = w.T
@@ -85,6 +96,31 @@ def convert_alexnet_state_dict(state_dict: Mapping[str, object], params):
         new_params[idx] = {"weight": jnp.asarray(w), "bias": jnp.asarray(b)}
 
     return tuple(new_params)
+
+
+def convert_alexnet_state_dict(state_dict: Mapping[str, object], params):
+    """Return a copy of tpuddp AlexNet ``params`` (tuple pytree from
+    ``AlexNet().init``) with weights replaced by the torch ``state_dict``."""
+    return _convert_seq_cnn(
+        state_dict, params, _CONV_KEYS, _LINEAR_KEYS,
+        first_linear="classifier.1", pool_grid=_POOL_GRID, pool_ch=_POOL_CH,
+    )
+
+
+# torchvision vgg11 ('A' config): conveniently the features.N indices coincide
+# with tpuddp's Sequential indices, like AlexNet's; the classifier starts at
+# 21 (AdaptiveAvgPool@21, Flatten@22, Linear@23, ReLU@24, Dropout@25,
+# Linear@26, ReLU@27, Dropout@28, Linear@29)
+_VGG11_CONV_KEYS = {f"features.{i}": i for i in (0, 3, 6, 8, 11, 13, 16, 18)}
+_VGG11_LINEAR_KEYS = {"classifier.0": 23, "classifier.3": 26, "classifier.6": 29}
+
+
+def convert_vgg11_state_dict(state_dict: Mapping[str, object], params):
+    """torchvision-layout VGG-11 ``state_dict`` -> tpuddp VGG11 params."""
+    return _convert_seq_cnn(
+        state_dict, params, _VGG11_CONV_KEYS, _VGG11_LINEAR_KEYS,
+        first_linear="classifier.0", pool_grid=7, pool_ch=512,
+    )
 
 
 def load_torch_alexnet(params, path: str):
@@ -309,10 +345,26 @@ def load_pretrained_resnet34(
     )
 
 
+def load_pretrained_vgg11(path: str, key, num_classes: int = 10, image_size: int = 224):
+    """VGG-11 analog of :func:`load_pretrained_alexnet`: build the model
+    sized to the checkpoint's own head, import, swap in a fresh
+    ``num_classes`` head when the widths differ."""
+    from tpuddp.models.vgg import VGG11
+
+    return _load_pretrained(
+        path, key, num_classes, image_size,
+        build=lambda n: VGG11(num_classes=n),
+        head_weight_key="classifier.6.weight",
+        convert=lambda sd, p, s: (convert_vgg11_state_dict(sd, p), s),
+        salt=0x9ea,
+    )
+
+
 _PRETRAINED_LOADERS = {
     "alexnet": load_pretrained_alexnet,
     "resnet18": load_pretrained_resnet18,
     "resnet34": load_pretrained_resnet34,
+    "vgg11": load_pretrained_vgg11,
     # s2d stems share the exact parameter layout, so the same torch
     # checkpoints load into them (the "_s2d = same checkpoints" promise)
     "alexnet_s2d": _pt(load_pretrained_alexnet, space_to_depth=True),

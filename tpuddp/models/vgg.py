@@ -1,0 +1,38 @@
+"""VGG-11 (configuration 'A') — NHWC, torchvision-layout-compatible.
+
+Extends the zoo beyond the reference's AlexNet (data_and_toy_model.py:41-45)
+with the other classic torchvision CNN a tutorial user reaches for; the layer
+ordering matches torchvision's ``vgg11`` exactly, so
+``tpuddp.models.torch_import.convert_vgg11_state_dict`` maps a torchvision
+checkpoint in logit-exactly (tests/test_torch_import.py).
+"""
+
+from __future__ import annotations
+
+from tpuddp import nn
+
+
+def VGG11(num_classes: int = 10, dropout: float = 0.5) -> nn.Sequential:
+    """torchvision VGG-11: 8 conv blocks (3x3/p1, maxpool after widths
+    64/128/256x2/512x2/512x2) -> adaptive 7x7 avg pool -> 3-layer classifier.
+    Input NHWC, any spatial size >= 32."""
+    features = []
+    in_plan = [(64, True), (128, True), (256, False), (256, True),
+               (512, False), (512, True), (512, False), (512, True)]
+    for width, pool in in_plan:
+        features.append(nn.Conv2d(width, kernel_size=3, padding=1))
+        features.append(nn.ReLU())
+        if pool:
+            features.append(nn.MaxPool2d(2, strides=2))
+    classifier = [
+        nn.AdaptiveAvgPool2d((7, 7)),
+        nn.Flatten(),
+        nn.Linear(4096),
+        nn.ReLU(),
+        nn.Dropout(dropout),
+        nn.Linear(4096),
+        nn.ReLU(),
+        nn.Dropout(dropout),
+        nn.Linear(num_classes),
+    ]
+    return nn.Sequential(*features, *classifier)
