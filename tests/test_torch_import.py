@@ -314,24 +314,21 @@ def test_pretrained_s2d_variants_load_same_checkpoint(tmp_path):
     np.testing.assert_allclose(out[0], out[1], rtol=1e-4, atol=1e-4)
 
 
-def _torch_vgg11(num_classes=1000):
-    """torchvision vgg11 topology in plain torch with the exact state_dict
-    key layout (torchvision is not installed)."""
-    features = tnn.Sequential(
-        tnn.Conv2d(3, 64, 3, padding=1), tnn.ReLU(inplace=True),
-        tnn.MaxPool2d(2, 2),
-        tnn.Conv2d(64, 128, 3, padding=1), tnn.ReLU(inplace=True),
-        tnn.MaxPool2d(2, 2),
-        tnn.Conv2d(128, 256, 3, padding=1), tnn.ReLU(inplace=True),
-        tnn.Conv2d(256, 256, 3, padding=1), tnn.ReLU(inplace=True),
-        tnn.MaxPool2d(2, 2),
-        tnn.Conv2d(256, 512, 3, padding=1), tnn.ReLU(inplace=True),
-        tnn.Conv2d(512, 512, 3, padding=1), tnn.ReLU(inplace=True),
-        tnn.MaxPool2d(2, 2),
-        tnn.Conv2d(512, 512, 3, padding=1), tnn.ReLU(inplace=True),
-        tnn.Conv2d(512, 512, 3, padding=1), tnn.ReLU(inplace=True),
-        tnn.MaxPool2d(2, 2),
-    )
+def _torch_vgg(name, num_classes=1000):
+    """torchvision VGG topology in plain torch with the exact state_dict key
+    layout (torchvision is not installed), built from the SAME plan as the
+    tpuddp model (tpuddp/models/vgg.py VGG_PLANS)."""
+    from tpuddp.models.vgg import VGG_PLANS
+
+    layers, in_ch = [], 3
+    for item in VGG_PLANS[name]:
+        if item == "M":
+            layers.append(tnn.MaxPool2d(2, 2))
+        else:
+            layers.append(tnn.Conv2d(in_ch, item, 3, padding=1))
+            layers.append(tnn.ReLU(inplace=True))
+            in_ch = item
+    features = tnn.Sequential(*layers)
     classifier = tnn.Sequential(
         tnn.Linear(512 * 7 * 7, 4096), tnn.ReLU(inplace=True), tnn.Dropout(),
         tnn.Linear(4096, 4096), tnn.ReLU(inplace=True), tnn.Dropout(),
@@ -354,16 +351,21 @@ def _torch_vgg11(num_classes=1000):
     return TorchVGG()
 
 
+def _torch_vgg11(num_classes=1000):
+    return _torch_vgg("vgg11", num_classes)
+
+
 @pytest.mark.slow
-def test_imported_vgg11_reproduces_torch_logits():
-    from tpuddp.models import VGG11
-    from tpuddp.models.torch_import import convert_vgg11_state_dict
+@pytest.mark.parametrize("name", ["vgg11", "vgg13", "vgg16"])
+def test_imported_vgg_reproduces_torch_logits(name):
+    from tpuddp.models import load_model
+    from tpuddp.models.torch_import import convert_vgg_state_dict
 
     torch.manual_seed(11)
-    donor = _torch_vgg11(num_classes=1000).eval()
-    model = VGG11(num_classes=1000)
+    donor = _torch_vgg(name, num_classes=1000).eval()
+    model = load_model(name, 1000)
     params, state = model.init(jax.random.key(0), jnp.zeros((1, 224, 224, 3)))
-    params = convert_vgg11_state_dict(donor.state_dict(), params)
+    params = convert_vgg_state_dict(name, donor.state_dict(), params)
     x = np.random.RandomState(4).randn(2, 224, 224, 3).astype(np.float32)
     ours = model.apply(params, state, jnp.asarray(x), Context(train=False))[0]
     with torch.no_grad():

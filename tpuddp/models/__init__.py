@@ -6,7 +6,7 @@ and a ResNet-18 for the multi-host BASELINE config."""
 from tpuddp.models.toy import ToyCNN, ToyMLP  # noqa: F401
 from tpuddp.models.alexnet import AlexNet  # noqa: F401
 from tpuddp.models.resnet import ResNet18, ResNet34  # noqa: F401
-from tpuddp.models.vgg import VGG11  # noqa: F401
+from tpuddp.models.vgg import VGG11, VGG13, VGG16  # noqa: F401
 
 from functools import partial as _partial
 
@@ -17,6 +17,8 @@ _REGISTRY = {
     "resnet18": ResNet18,
     "resnet34": ResNet34,
     "vgg11": VGG11,
+    "vgg13": VGG13,
+    "vgg16": VGG16,
     # CIFAR-style stem (3x3 conv, no maxpool) for small native resolutions
     "resnet18_small": _partial(ResNet18, small_input=True),
     "resnet34_small": _partial(ResNet34, small_input=True),
@@ -38,6 +40,7 @@ def load_model(name: str = "alexnet", num_classes: int = 10, **kwargs):
 
 
 __all__ = [
-    "ToyMLP", "ToyCNN", "AlexNet", "ResNet18", "ResNet34", "VGG11",
+    "ToyMLP", "ToyCNN", "AlexNet", "ResNet18", "ResNet34",
+    "VGG11", "VGG13", "VGG16",
     "load_model",
 ]

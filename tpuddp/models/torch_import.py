@@ -107,20 +107,25 @@ def convert_alexnet_state_dict(state_dict: Mapping[str, object], params):
     )
 
 
-# torchvision vgg11 ('A' config): conveniently the features.N indices coincide
-# with tpuddp's Sequential indices, like AlexNet's; the classifier starts at
-# 21 (AdaptiveAvgPool@21, Flatten@22, Linear@23, ReLU@24, Dropout@25,
-# Linear@26, ReLU@27, Dropout@28, Linear@29)
-_VGG11_CONV_KEYS = {f"features.{i}": i for i in (0, 3, 6, 8, 11, 13, 16, 18)}
-_VGG11_LINEAR_KEYS = {"classifier.0": 23, "classifier.3": 26, "classifier.6": 29}
+def convert_vgg_state_dict(name: str, state_dict: Mapping[str, object], params):
+    """torchvision-layout VGG ``state_dict`` -> tpuddp VGG params. The
+    ``features.N`` conv index map and the classifier Linear positions are
+    GENERATED from the same plan that builds the tpuddp model
+    (tpuddp/models/vgg.py), so the correspondence can't drift."""
+    from tpuddp.models.vgg import vgg_classifier_linear_indices, vgg_conv_indices
+
+    conv_keys = {f"features.{i}": i for i in vgg_conv_indices(name)}
+    l0, l1, l2 = vgg_classifier_linear_indices(name)
+    linear_keys = {"classifier.0": l0, "classifier.3": l1, "classifier.6": l2}
+    return _convert_seq_cnn(
+        state_dict, params, conv_keys, linear_keys,
+        first_linear="classifier.0", pool_grid=7, pool_ch=512,
+    )
 
 
 def convert_vgg11_state_dict(state_dict: Mapping[str, object], params):
     """torchvision-layout VGG-11 ``state_dict`` -> tpuddp VGG11 params."""
-    return _convert_seq_cnn(
-        state_dict, params, _VGG11_CONV_KEYS, _VGG11_LINEAR_KEYS,
-        first_linear="classifier.0", pool_grid=7, pool_ch=512,
-    )
+    return convert_vgg_state_dict("vgg11", state_dict, params)
 
 
 def load_torch_alexnet(params, path: str):
@@ -345,17 +350,20 @@ def load_pretrained_resnet34(
     )
 
 
-def load_pretrained_vgg11(path: str, key, num_classes: int = 10, image_size: int = 224):
-    """VGG-11 analog of :func:`load_pretrained_alexnet`: build the model
-    sized to the checkpoint's own head, import, swap in a fresh
-    ``num_classes`` head when the widths differ."""
-    from tpuddp.models.vgg import VGG11
+def load_pretrained_vgg(
+    name: str, path: str, key, num_classes: int = 10, image_size: int = 224
+):
+    """VGG analog of :func:`load_pretrained_alexnet`: build the model sized
+    to the checkpoint's own head, import, swap in a fresh ``num_classes``
+    head when the widths differ."""
+    from tpuddp.models import vgg as vgg_lib
 
+    build_cls = {"vgg11": vgg_lib.VGG11, "vgg13": vgg_lib.VGG13, "vgg16": vgg_lib.VGG16}[name]
     return _load_pretrained(
         path, key, num_classes, image_size,
-        build=lambda n: VGG11(num_classes=n),
+        build=lambda n: build_cls(num_classes=n),
         head_weight_key="classifier.6.weight",
-        convert=lambda sd, p, s: (convert_vgg11_state_dict(sd, p), s),
+        convert=lambda sd, p, s: (convert_vgg_state_dict(name, sd, p), s),
         salt=0x9ea,
     )
 
@@ -364,7 +372,9 @@ _PRETRAINED_LOADERS = {
     "alexnet": load_pretrained_alexnet,
     "resnet18": load_pretrained_resnet18,
     "resnet34": load_pretrained_resnet34,
-    "vgg11": load_pretrained_vgg11,
+    "vgg11": _pt(load_pretrained_vgg, "vgg11"),
+    "vgg13": _pt(load_pretrained_vgg, "vgg13"),
+    "vgg16": _pt(load_pretrained_vgg, "vgg16"),
     # s2d stems share the exact parameter layout, so the same torch
     # checkpoints load into them (the "_s2d = same checkpoints" promise)
     "alexnet_s2d": _pt(load_pretrained_alexnet, space_to_depth=True),

@@ -1,29 +1,59 @@
-"""VGG-11 (configuration 'A') — NHWC, torchvision-layout-compatible.
+"""VGG family (configurations A/B/D = VGG-11/13/16) — NHWC,
+torchvision-layout-compatible.
 
 Extends the zoo beyond the reference's AlexNet (data_and_toy_model.py:41-45)
-with the other classic torchvision CNN a tutorial user reaches for; the layer
-ordering matches torchvision's ``vgg11`` exactly, so
-``tpuddp.models.torch_import.convert_vgg11_state_dict`` maps a torchvision
-checkpoint in logit-exactly (tests/test_torch_import.py).
+with the classic torchvision CNNs a tutorial user reaches for. Both the
+tpuddp Sequential AND the torchvision ``features.N`` index map are generated
+from ONE plan per config, so the checkpoint converter's correspondence holds
+by construction (tpuddp.models.torch_import.convert_vgg_state_dict;
+logit-exact tests in tests/test_torch_import.py).
 """
 
 from __future__ import annotations
 
 from tpuddp import nn
 
+# torchvision cfgs: numbers are conv widths, "M" is a 2x2/s2 maxpool
+VGG_PLANS = {
+    "vgg11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "vgg13": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "vgg16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+              512, 512, 512, "M"],
+}
 
-def VGG11(num_classes: int = 10, dropout: float = 0.5) -> nn.Sequential:
-    """torchvision VGG-11: 8 conv blocks (3x3/p1, maxpool after widths
-    64/128/256x2/512x2/512x2) -> adaptive 7x7 avg pool -> 3-layer classifier.
-    Input NHWC, any spatial size >= 32."""
+
+def vgg_conv_indices(name: str):
+    """The torchvision ``features.N`` indices that hold convs — identical to
+    the conv positions in tpuddp's Sequential, because both are generated
+    from the same plan (conv -> +2 for conv+ReLU, "M" -> +1 for the pool)."""
+    idx, out = 0, []
+    for item in VGG_PLANS[name]:
+        if item == "M":
+            idx += 1
+        else:
+            out.append(idx)
+            idx += 2
+    return tuple(out)
+
+
+def vgg_classifier_linear_indices(name: str):
+    """Sequential indices of the three classifier Linears: features occupy
+    [0, F), then AdaptiveAvgPool@F, Flatten@F+1, Linear@F+2, ReLU, Dropout,
+    Linear@F+5, ReLU, Dropout, Linear@F+8."""
+    f = 0
+    for item in VGG_PLANS[name]:
+        f += 1 if item == "M" else 2
+    return (f + 2, f + 5, f + 8)
+
+
+def _vgg(name: str, num_classes: int, dropout: float) -> nn.Sequential:
     features = []
-    in_plan = [(64, True), (128, True), (256, False), (256, True),
-               (512, False), (512, True), (512, False), (512, True)]
-    for width, pool in in_plan:
-        features.append(nn.Conv2d(width, kernel_size=3, padding=1))
-        features.append(nn.ReLU())
-        if pool:
+    for item in VGG_PLANS[name]:
+        if item == "M":
             features.append(nn.MaxPool2d(2, strides=2))
+        else:
+            features.append(nn.Conv2d(item, kernel_size=3, padding=1))
+            features.append(nn.ReLU())
     classifier = [
         nn.AdaptiveAvgPool2d((7, 7)),
         nn.Flatten(),
@@ -36,3 +66,18 @@ def VGG11(num_classes: int = 10, dropout: float = 0.5) -> nn.Sequential:
         nn.Linear(num_classes),
     ]
     return nn.Sequential(*features, *classifier)
+
+
+def VGG11(num_classes: int = 10, dropout: float = 0.5) -> nn.Sequential:
+    """torchvision vgg11 ('A'): 8 convs. Input NHWC, any spatial size >= 32."""
+    return _vgg("vgg11", num_classes, dropout)
+
+
+def VGG13(num_classes: int = 10, dropout: float = 0.5) -> nn.Sequential:
+    """torchvision vgg13 ('B'): 10 convs."""
+    return _vgg("vgg13", num_classes, dropout)
+
+
+def VGG16(num_classes: int = 10, dropout: float = 0.5) -> nn.Sequential:
+    """torchvision vgg16 ('D'): 13 convs."""
+    return _vgg("vgg16", num_classes, dropout)
