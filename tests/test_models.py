@@ -236,3 +236,37 @@ def test_small_input_rejects_space_to_depth():
 
     with pytest.raises(ValueError, match="small_input"):
         ResNet18(small_input=True, space_to_depth=True)
+
+
+def test_space_to_depth_fuzz_matches_conv2d():
+    """Property check over random geometries: SpaceToDepthConv2d == Conv2d
+    for any (k, s, p, h, w) it accepts — the padding/blocking arithmetic must
+    hold everywhere, not just the stems we ship."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuddp import nn
+    from tpuddp.nn.core import Context
+
+    rs = np.random.RandomState(42)
+    for trial in range(12):
+        s = int(rs.randint(2, 5))
+        k = int(rs.randint(1, 12))
+        p = int(rs.randint(0, k + 2))
+        h = int(rs.randint(max(k - p, s), 40))
+        w = int(rs.randint(max(k - p, s), 40))
+        c = int(rs.choice([1, 3, 5]))
+        if (h + 2 * p - k) < 0 or (w + 2 * p - k) < 0:
+            continue
+        ref = nn.Conv2d(8, kernel_size=k, strides=s, padding=p)
+        s2d = nn.SpaceToDepthConv2d(8, kernel_size=k, strides=s, padding=p)
+        x = jnp.asarray(rs.randn(2, h, w, c).astype(np.float32))
+        params, _ = ref.init(jax.random.key(trial), x)
+        y_ref, _ = ref.apply(params, (), x, Context())
+        y_s2d, _ = s2d.apply(params, (), x, Context())
+        assert y_ref.shape == y_s2d.shape, (trial, k, s, p, h, w, c)
+        np.testing.assert_allclose(
+            np.asarray(y_ref), np.asarray(y_s2d), rtol=1e-4, atol=1e-4,
+            err_msg=f"trial {trial}: k={k} s={s} p={p} h={h} w={w} c={c}",
+        )
