@@ -184,12 +184,13 @@ def test_deferred_metrics_matches_eager(cpu_devices):
         augment = jax.jit(lambda rng, i, v: _aug(jax.random.fold_in(rng, i), v))
         eval_tf = jax.jit(make_eval_transform(size=None))
         prepared_loader.set_epoch(0)
-        tr = ta.train(
+        tr, n_tr = ta.train(
             model, prepared_loader, criterion, opt, accel, augment, deferred=deferred
         )
-        te, pct = ta.evaluate(
+        te, pct, n_te = ta.evaluate(
             model, test_loader, criterion, accel.device, eval_tf, deferred=deferred
         )
+        assert n_tr == 64.0 and n_te == 64
         results.append((tr, te, pct))
     np.testing.assert_allclose(results[0], results[1], rtol=1e-6)
     # scan fusion must be a pure batching change: identical metrics

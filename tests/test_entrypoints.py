@@ -176,3 +176,38 @@ def test_native_cli_subprocess_with_reexec_launcher(tmp_path):
     assert os.path.exists(tmp_path / "out" / "ckpt_0.npz")
     # provenance copy of the settings file into out_dir
     assert os.path.exists(tmp_path / "out" / "s.yaml")
+
+
+def test_accelerate_entrypoint_observability_parity(tmp_path, capsys, monkeypatch):
+    """The managed loop honors the same observability hooks as the native
+    one: history.jsonl written by process 0, and $TPUDDP_DEBUG_NANS guards
+    the aggregated losses."""
+    import json
+
+    from train_accelerate import basic_accelerate_training
+
+    training = dict(TINY_TRAINING, num_epochs=2, deferred_metrics=True)
+    basic_accelerate_training(str(tmp_path), training)
+    capsys.readouterr()
+    lines = [
+        json.loads(l)
+        for l in open(tmp_path / "history.jsonl").read().splitlines()
+    ]
+    assert len(lines) == 2
+    assert {"epoch", "train_loss", "test_loss", "test_accuracy"} <= set(lines[0])
+
+    # NaN guard: a poisoned epoch must still write its post-mortem row
+    # (record-before-check, native-driver parity) and then raise
+    monkeypatch.setenv("TPUDDP_DEBUG_NANS", "1")
+    monkeypatch.setattr(
+        "train_accelerate.train", lambda *a, **k: (float("nan"), 8.0)
+    )
+    monkeypatch.setattr(
+        "train_accelerate.evaluate", lambda *a, **k: (0.1, 50.0, 8)
+    )
+    with pytest.raises(FloatingPointError, match="train loss"):
+        basic_accelerate_training(str(tmp_path / "nan"), training)
+    last = json.loads(
+        open(tmp_path / "nan" / "history.jsonl").read().splitlines()[-1]
+    )
+    assert last["epoch"] == 0 and last["train_loss"] != last["train_loss"]  # NaN

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import time
 
 import jax
 import jax.numpy as jnp
@@ -49,8 +50,11 @@ def setup_dataloaders(training):
 def train(
     model, train_loader, criterion, optimizer, accelerator, augment, deferred=False
 ):
+    """One training epoch. Returns ``(mean_batch_loss, samples_seen)`` —
+    the weighted sample count feeds the history.jsonl throughput fields."""
     model.train()
     running_loss = 0.0
+    n_seen = 0.0
     batch_losses = []
     # ONE fresh key per epoch; the per-batch key is fold_in(base, i) INSIDE
     # the jitted augment — an eager split per batch would be a device
@@ -58,6 +62,7 @@ def train(
     aug_base = accelerator.next_rng_key()
     for i, (inputs, labels, weights) in enumerate(train_loader):
         # no .to(device): placement is the backend's job (reference :44 note)
+        n_seen += float(np.sum(weights))
         optimizer.zero_grad()
 
         # Flip-augmented inputs (reference transform_train includes
@@ -92,7 +97,7 @@ def train(
         from tpuddp.accelerate import sum_losses
 
         running_loss = float(sum_losses(batch_losses))
-    return running_loss / len(train_loader)
+    return running_loss / len(train_loader), n_seen
 
 
 def transform_host(transform, inputs):
@@ -103,6 +108,7 @@ def transform_host(transform, inputs):
 
 
 def evaluate(model, test_loader, criterion, device, transform, deferred=False):
+    """Returns ``(mean_batch_loss, accuracy_pct, total_samples)``."""
     model.eval()
     if deferred:
         # scan-fused eval: transform + forward + loss + metric accumulation
@@ -122,7 +128,7 @@ def evaluate(model, test_loader, criterion, device, transform, deferred=False):
             ev.add(inputs, labels, weights)
         test_loss, correct, total = ev.finalize()
         accuracy = 100 * correct / total
-        return test_loss / len(test_loader), accuracy
+        return test_loss / len(test_loader), accuracy, total
     correct = 0
     total = 0
     test_loss = 0.0
@@ -136,7 +142,7 @@ def evaluate(model, test_loader, criterion, device, transform, deferred=False):
         total += int(mask.sum())
         correct += int(((predicted == labels) & mask).sum())
     accuracy = 100 * correct / total
-    return test_loss / len(test_loader), accuracy
+    return test_loss / len(test_loader), accuracy, total
 
 
 def run_training_loop(
@@ -154,42 +160,86 @@ def run_training_loop(
     deferred_metrics=False,
     start_epoch=0,
 ):
-    for epoch in range(start_epoch, num_epochs):
-        train_loader.set_epoch(epoch)
-        train_loss = train(
-            model,
-            train_loader,
-            criterion,
-            optimizer,
-            accelerator,
-            augment,
-            deferred=deferred_metrics,
-        )
-        test_loss, test_accuracy = evaluate(
-            model,
-            test_loader,
-            criterion,
-            accelerator.device,
-            eval_transform,
-            deferred=deferred_metrics,
-        )
+    # Observability parity with the native epoch driver (training/loop.py):
+    # $TPUDDP_PROFILE traces the first epoch, $TPUDDP_DEBUG_NANS guards the
+    # aggregated losses, and process 0 appends history.jsonl next to the
+    # checkpoints.
+    from tpuddp.utils.observability import (
+        MetricsWriter,
+        check_finite,
+        maybe_start_profiler,
+        stop_profiler,
+    )
 
-        # epoch summary, gated to one process (reference :96-102)
-        if accelerator.is_local_main_process:
-            print(
-                f"Epoch {epoch + 1}/{num_epochs}, "
-                f"Train Loss: {train_loss:.4f}, "
-                f"Test Loss: {test_loss:.4f}, "
-                f"Test Accuracy: {test_accuracy:.2f}%"
+    metrics_writer = MetricsWriter(save_dir)
+    profiling = maybe_start_profiler(save_dir)
+    try:
+        for epoch in range(start_epoch, num_epochs):
+            train_loader.set_epoch(epoch)
+            epoch_t0 = time.perf_counter()
+            train_loss, train_samples = train(
+                model,
+                train_loader,
+                criterion,
+                optimizer,
+                accelerator,
+                augment,
+                deferred=deferred_metrics,
             )
+            test_loss, test_accuracy, test_samples = evaluate(
+                model,
+                test_loader,
+                criterion,
+                accelerator.device,
+                eval_transform,
+                deferred=deferred_metrics,
+            )
+            epoch_time = time.perf_counter() - epoch_t0
 
-        if epoch % checkpoint_epoch == 0:
-            # barrier, then a single-writer save of the unwrapped weights
-            # (reference :104-108) PLUS the lossless full state (weights +
-            # optimizer moments + RNG position) that training.resume restores
-            accelerator.wait_for_everyone()
-            accelerator.save_model(model, save_dir)
-            accelerator.save_state(model, optimizer, save_dir, epoch=epoch)
+            if profiling and epoch == start_epoch:
+                stop_profiler()  # trace the first epoch only
+                profiling = False
+
+            # epoch summary, gated to one process (reference :96-102)
+            if accelerator.is_local_main_process:
+                print(
+                    f"Epoch {epoch + 1}/{num_epochs}, "
+                    f"Train Loss: {train_loss:.4f}, "
+                    f"Test Loss: {test_loss:.4f}, "
+                    f"Test Accuracy: {test_accuracy:.2f}%"
+                )
+            # native-driver record schema (training/loop.py), written BEFORE
+            # the NaN guard so a blown-up epoch still leaves its post-mortem
+            # row in history.jsonl
+            metrics_writer.write(
+                {
+                    "epoch": epoch,
+                    "train_loss": train_loss,
+                    "test_loss": test_loss,
+                    "test_accuracy": test_accuracy,
+                    "train_samples": train_samples,
+                    "test_samples": test_samples,
+                    "epoch_time_s": epoch_time,
+                    "samples_per_sec": (train_samples + test_samples)
+                    / max(epoch_time, 1e-9),
+                }
+            )
+            check_finite(train_loss, "train loss")  # $TPUDDP_DEBUG_NANS guard
+            check_finite(test_loss, "test loss")
+
+            if epoch % checkpoint_epoch == 0:
+                # barrier, then a single-writer save of the unwrapped weights
+                # (reference :104-108) PLUS the lossless full state (weights +
+                # optimizer moments + RNG position) that training.resume
+                # restores
+                accelerator.wait_for_everyone()
+                accelerator.save_model(model, save_dir)
+                accelerator.save_state(model, optimizer, save_dir, epoch=epoch)
+    finally:
+        if profiling:
+            # an exception mid-first-epoch must still flush the trace (it is
+            # the post-mortem artifact) and release the profiler latch
+            stop_profiler()
 
     print("Finished Training.")
 
