@@ -37,7 +37,8 @@ TRAINING_DEFAULTS = {
     "seed": None,  # None -> fresh per run, like torch initial_seed
     "mode": "shard_map",
     "sync_bn": False,
-    "scan_steps": "auto",  # K train steps fused per dispatch (lax.scan); "auto" = up to 8
+    "scan_steps": "auto",  # K train steps fused per dispatch (lax.scan);
+    # "auto" = size-resolved: up to 64 for sub-4MB models, 16 otherwise
     "clip_grad_norm": None,  # clip the cross-replica-AVERAGED grad (README's
     # clip-before-aggregate caveat: clipping per-shard grads then averaging
     # would differ; tpuddp clips after the pmean, identically on all replicas)
