@@ -34,6 +34,8 @@ def test_all_reduce_pytree_and_ops(mesh):
     out = shmap(mesh, lambda t: col.all_reduce(t, "max"), P(DATA_AXIS), P())(tree)
     np.testing.assert_allclose(out["a"], [7.0])
     np.testing.assert_allclose(out["b"], [1.0])
+    out = shmap(mesh, col.pmax, P(DATA_AXIS), P())(jnp.arange(8.0))
+    np.testing.assert_allclose(out, [7.0])
     with pytest.raises(ValueError):
         col.all_reduce(jnp.ones(8), "median")
 
@@ -77,10 +79,14 @@ def test_axis_index_is_rank(mesh):
     np.testing.assert_array_equal(out, np.arange(8))
 
 
-def test_host_sum_aggregates_sharded_metrics(mesh):
-    # per-device partial sums, as the train step emits them
+def test_finalize_metrics_aggregates_sharded_metrics(mesh):
+    # per-device partial sums, as the shard_map train step emits them; the
+    # epoch-end path (the reference's five dist.all_reduce calls,
+    # multi-GPU-training-torch.py:198-204) is finalize_metrics
+    from tpuddp.training.step import finalize_metrics
+
     parts = jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P(DATA_AXIS)))
-    assert float(col.host_sum(parts)) == 28.0
+    assert finalize_metrics({"loss_sum": parts})["loss_sum"] == 28.0
 
 
 def test_barrier_single_host_noop(mesh):

@@ -35,6 +35,7 @@ def fresh_backend():
     backend.cleanup()
 
 
+@pytest.mark.slow
 def test_native_entrypoint_end_to_end(tmp_path, capsys):
     from functools import partial
 
@@ -57,6 +58,7 @@ def test_native_entrypoint_end_to_end(tmp_path, capsys):
     assert "TRAIN: Batch 0" in out  # shard-disjointness probe
 
 
+@pytest.mark.slow
 def test_accelerate_entrypoint_end_to_end(tmp_path, capsys):
     from train_accelerate import basic_accelerate_training
 
@@ -68,6 +70,7 @@ def test_accelerate_entrypoint_end_to_end(tmp_path, capsys):
     assert "Finished Training." in out
 
 
+@pytest.mark.slow
 def test_accelerate_entrypoint_resume(tmp_path, capsys):
     """training.resume on the managed path: a first run leaves
     state_{epoch}.npz files; a restarted run restores the newest (weights +
@@ -178,6 +181,7 @@ def test_native_cli_subprocess_with_reexec_launcher(tmp_path):
     assert os.path.exists(tmp_path / "out" / "s.yaml")
 
 
+@pytest.mark.slow
 def test_accelerate_entrypoint_observability_parity(tmp_path, capsys, monkeypatch):
     """The managed loop honors the same observability hooks as the native
     one: history.jsonl written by process 0, and $TPUDDP_DEBUG_NANS guards

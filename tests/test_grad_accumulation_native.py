@@ -1,0 +1,244 @@
+"""Native gradient accumulation (DistributedDataParallel(grad_accumulation=A)):
+one optimizer update per A micro-batches, fused into the scan step.
+
+The defining property: a cycle of A micro-batches produces EXACTLY the update
+of one step over their concatenation (the n-weighted gradient average), so the
+equivalence oracle is the plain step at A-times the batch size. The managed
+path's gradient_accumulation_steps has its own tests (test_accelerate.py);
+here the two knobs' trajectories are also cross-checked.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuddp import optim
+from tpuddp.data import SyntheticClassification
+from tpuddp.models import ToyCNN, ToyMLP
+from tpuddp.nn import CrossEntropyLoss
+from tpuddp.parallel import make_mesh
+from tpuddp.parallel.ddp import DistributedDataParallel
+from tpuddp.training.step import stack_batches
+
+KEY = jax.random.key(3)
+
+
+def make_batches(k, n=16, shape=(8, 8, 3), seed=0):
+    ds = SyntheticClassification(n=n * k, shape=shape, seed=seed)
+    return [
+        (
+            ds.images[i * n : (i + 1) * n],
+            ds.labels[i * n : (i + 1) * n],
+            np.ones(n, np.float32),
+        )
+        for i in range(k)
+    ]
+
+
+def _leaves_allclose(a, b, atol):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        if jax.dtypes.issubdtype(np.asarray(x).dtype, jax.dtypes.prng_key):
+            continue
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol)
+
+
+@pytest.mark.parametrize("mode", ["shard_map", "auto"])
+@pytest.mark.parametrize("wus", [False, True])
+def test_accum_cycle_equals_concatenated_batch(cpu_devices, mode, wus):
+    """A=4 over 4 micro-batches of 16 == 1 plain step over the 64-batch, to
+    float tolerance (identical math modulo reduction order). SGD keeps the
+    comparison free of adaptive-state amplification."""
+    if wus and mode != "shard_map":
+        pytest.skip("wus is shard_map-only")
+    mesh = make_mesh(cpu_devices)
+    batches = make_batches(4)
+    model = ToyMLP()
+
+    def fresh(accum):
+        ddp = DistributedDataParallel(
+            model, optim.SGD(1e-1), CrossEntropyLoss(), mesh=mesh, mode=mode,
+            grad_accumulation=accum, weight_update_sharding=wus,
+        )
+        return ddp, ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+
+    acc_ddp, acc_state = fresh(4)
+    acc_state, acc_m = acc_ddp.train_step_many(
+        acc_state, acc_ddp.shard_stacked(stack_batches(batches))
+    )
+
+    big_ddp, big_state = fresh(1)
+    xs = np.concatenate([b[0] for b in batches])
+    ys = np.concatenate([b[1] for b in batches])
+    ws = np.concatenate([b[2] for b in batches])
+    big_state, big_m = big_ddp.train_step(big_state, big_ddp.shard((xs, ys, ws)))
+
+    _leaves_allclose(acc_state.params, big_state.params, atol=1e-5)
+    # metric totals: loss_sum over micro-batches == weighted loss of the
+    # concatenation (same per-sample losses on step 0's identical params)
+    assert np.isclose(
+        float(np.sum(np.asarray(acc_m["loss_sum"]))),
+        float(np.sum(np.asarray(big_m["loss_sum"]))),
+        atol=1e-4,
+    )
+    assert float(np.sum(np.asarray(acc_m["n"]))) == 64.0
+
+
+def test_accum_trajectory_multiple_cycles_adam(cpu_devices):
+    """2 cycles of A=2 (scan K=4) track 2 plain Adam steps at doubled batch.
+    ToyMLP: BatchNorm models are deliberately excluded — normalizing each
+    micro-batch with its OWN statistics makes accumulation inequivalent to the
+    concatenated batch (inherent to BN; torch behaves identically)."""
+    mesh = make_mesh(cpu_devices)
+    batches = make_batches(4, n=16, seed=1)
+    model = ToyMLP()
+
+    a_ddp = DistributedDataParallel(
+        model, optim.Adam(1e-2), CrossEntropyLoss(), mesh=mesh,
+        grad_accumulation=2,
+    )
+    a_state = a_ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    a_state, _ = a_ddp.train_step_many(
+        a_state, a_ddp.shard_stacked(stack_batches(batches))
+    )
+
+    b_ddp = DistributedDataParallel(
+        model, optim.Adam(1e-2), CrossEntropyLoss(), mesh=mesh
+    )
+    b_state = b_ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    for i in range(2):
+        x = np.concatenate([batches[2 * i][0], batches[2 * i + 1][0]])
+        y = np.concatenate([batches[2 * i][1], batches[2 * i + 1][1]])
+        w = np.concatenate([batches[2 * i][2], batches[2 * i + 1][2]])
+        b_state, _ = b_ddp.train_step(b_state, b_ddp.shard((x, y, w)))
+
+    _leaves_allclose(a_state.params, b_state.params, atol=2e-4)
+
+    # BN accumulation still RUNS and stays finite (its inequivalence is a
+    # documented property, not a crash)
+    c_ddp = DistributedDataParallel(
+        ToyCNN(sync_bn=True), optim.Adam(1e-2), CrossEntropyLoss(), mesh=mesh,
+        grad_accumulation=2,
+    )
+    c_state = c_ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    c_state, _ = c_ddp.train_step_many(
+        c_state, c_ddp.shard_stacked(stack_batches(batches))
+    )
+    for leaf in jax.tree_util.tree_leaves((c_state.params, c_state.model_state)):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_all_padding_microbatch_is_inert(cpu_devices):
+    """A tail padded with weight-0 micro-batches must produce the same update
+    as the unpadded cycle (the epoch driver's _pad_to_cycles contract)."""
+    mesh = make_mesh(cpu_devices)
+    batches = make_batches(2, n=16, seed=2)
+    model = ToyMLP()
+
+    def run(bs, accum):
+        ddp = DistributedDataParallel(
+            model, optim.SGD(1e-1), CrossEntropyLoss(), mesh=mesh,
+            grad_accumulation=accum,
+        )
+        state = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        state, m = ddp.train_step_many(state, ddp.shard_stacked(stack_batches(bs)))
+        return state, m
+
+    # cycle of 2 live micro-batches
+    s2, m2 = run(batches, 2)
+    # cycle of 4 = same 2 live + 2 all-padding
+    x0, y0, w0 = batches[-1]
+    padded = batches + [(x0, y0, np.zeros_like(w0))] * 2
+    s4, m4 = run(padded, 4)
+
+    _leaves_allclose(s2.params, s4.params, atol=1e-6)
+    assert float(np.sum(np.asarray(m4["n"]))) == float(np.sum(np.asarray(m2["n"])))
+    assert np.isclose(
+        float(np.sum(np.asarray(m4["loss_sum"]))),
+        float(np.sum(np.asarray(m2["loss_sum"]))),
+        atol=1e-5,
+    )
+
+
+def test_non_multiple_scan_length_refused(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    ddp = DistributedDataParallel(
+        ToyMLP(), optim.SGD(1e-1), CrossEntropyLoss(), mesh=mesh,
+        grad_accumulation=3,
+    )
+    state = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    with pytest.raises(ValueError, match="multiple of"):
+        ddp.train_step_many(
+            state, ddp.shard_stacked(stack_batches(make_batches(4)))
+        )
+
+
+def test_per_batch_step_refused_under_accumulation(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    ddp = DistributedDataParallel(
+        ToyMLP(), optim.SGD(1e-1), CrossEntropyLoss(), mesh=mesh,
+        grad_accumulation=2,
+    )
+    state = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    with pytest.raises(RuntimeError, match="grad_accumulation"):
+        ddp.train_step(state, ddp.shard(make_batches(1)[0]))
+
+
+def test_loop_pads_ragged_tail(cpu_devices):
+    """End-to-end: 5 batches with A=2 -> 2-cycle chunks + a padded tail; the
+    epoch must see exactly the real samples and a finite loss."""
+    from tpuddp.data import ShardedDataLoader
+    from tpuddp.training.loop import run_training_loop
+
+    mesh = make_mesh(cpu_devices)
+    ds = SyntheticClassification(n=5 * 16, shape=(8, 8, 3), seed=3)
+    # batch_size is PER-REPLICA: 2 x 8 devices = 16 global -> 5 batches/epoch
+    train = ShardedDataLoader(ds, batch_size=2, mesh=mesh, shuffle=True)
+    test = ShardedDataLoader(ds, batch_size=2, mesh=mesh, shuffle=False)
+    ddp = DistributedDataParallel(
+        ToyMLP(), optim.Adam(1e-2), CrossEntropyLoss(), mesh=mesh,
+        grad_accumulation=2,
+    )
+    state = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    state, history = run_training_loop(
+        ddp, state, train, test, save_dir=None, num_epochs=2,
+        checkpoint_epoch=10, scan_steps=2, log=lambda *a, **k: None,
+    )
+    for rec in history:
+        assert rec["train_samples"] == 80.0
+        assert np.isfinite(rec["train_loss"])
+    # 5 batches/epoch with K=2, A=2: two full chunks (2 cycles) + a 1-batch
+    # tail padded to a whole cycle -> 6 micro-steps on state.step per epoch
+    assert int(np.asarray(state.step)) == 12
+
+
+def test_native_cli_accepts_gradient_accumulation(tmp_path):
+    """Config-level wiring: gradient_accumulation_steps is a native-path knob
+    now (was refused through round 4)."""
+    import yaml
+
+    from tpuddp import config as cfg_lib
+
+    settings = {
+        "script_path": "train_native.py",
+        "out_dir": str(tmp_path / "out"),
+        "optional_args": {"set_epoch": True, "print_rand": False},
+        "local": {"device": "cpu"},
+        "training": {
+            "dataset": "synthetic",
+            "model": "toy_mlp",
+            "num_epochs": 1,
+            "train_batch_size": 16,
+            "test_batch_size": 16,
+            "learning_rate": 0.01,
+            "checkpoint_epoch": 5,
+            "gradient_accumulation_steps": 2,
+        },
+    }
+    p = tmp_path / "settings.yaml"
+    p.write_text(yaml.safe_dump(settings))
+    cfg = cfg_lib.load_settings(str(p))
+    assert int(cfg["training"]["gradient_accumulation_steps"]) == 2

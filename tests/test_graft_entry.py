@@ -14,6 +14,7 @@ import subprocess
 import sys
 
 import jax
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -41,6 +42,7 @@ def _driver_env(n: int) -> dict:
     return env
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_under_driver_env():
     proc = subprocess.run(
         [sys.executable, "-c", "import __graft_entry__ as g; g.dryrun_multichip(8)"],
@@ -57,6 +59,7 @@ def test_dryrun_multichip_under_driver_env():
     assert "dryrun_multichip ok: 8 devices" in proc.stdout
 
 
+@pytest.mark.slow
 def test_entry_lowers_and_compiles():
     import __graft_entry__ as g
 

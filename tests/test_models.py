@@ -238,6 +238,7 @@ def test_small_input_rejects_space_to_depth():
         ResNet18(small_input=True, space_to_depth=True)
 
 
+@pytest.mark.slow
 def test_space_to_depth_fuzz_matches_conv2d():
     """Property check over random geometries: SpaceToDepthConv2d == Conv2d
     for any (k, s, p, h, w) it accepts — the padding/blocking arithmetic must

@@ -373,6 +373,7 @@ def test_imported_vgg_reproduces_torch_logits(name):
     np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_pretrained_vgg11_head_swap_from_config(tmp_path):
     from tpuddp.models.torch_import import pretrained_from_config
 

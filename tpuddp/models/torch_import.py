@@ -123,11 +123,6 @@ def convert_vgg_state_dict(name: str, state_dict: Mapping[str, object], params):
     )
 
 
-def convert_vgg11_state_dict(state_dict: Mapping[str, object], params):
-    """torchvision-layout VGG-11 ``state_dict`` -> tpuddp VGG11 params."""
-    return convert_vgg_state_dict("vgg11", state_dict, params)
-
-
 def load_torch_alexnet(params, path: str):
     """Load a torch ``.pt``/``.pth`` AlexNet state_dict from ``path`` and
     convert. Requires torch at call time (it is a dev/test dependency only)."""

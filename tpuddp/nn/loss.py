@@ -35,7 +35,14 @@ def cross_entropy(
     if reduction == "sum":
         return jnp.sum(losses)
     if reduction == "mean":
-        denom = jnp.sum(weights) if weights is not None else losses.shape[0]
+        if weights is not None:
+            # an all-padding (weight-0) batch means 0 loss, not 0/0 — the
+            # grad-accumulation tail pads whole micro-batches to a static
+            # cycle length (training/loop.py) and their grads must vanish
+            denom = jnp.sum(weights)
+            denom = jnp.where(denom == 0, 1.0, denom)
+        else:
+            denom = losses.shape[0]
         return jnp.sum(losses) / denom
     raise ValueError(f"unknown reduction {reduction!r}")
 

@@ -144,16 +144,3 @@ def broadcast_one_to_all(pytree, is_source: Optional[bool] = None):
     return jax.tree_util.tree_unflatten(treedef, restored)
 
 
-_host_sum_jit = jax.jit(jnp.sum)
-
-
-def host_sum(x):
-    """Sum a metric array that is sharded across devices (shape [world] from a
-    per-shard shard_map output) into a single host scalar — the epoch-end
-    ``dist.all_reduce`` of the reference (multi-GPU-training-torch.py:198-204).
-
-    Under jit, the sum over the sharded axis compiles to an XLA cross-device
-    reduction; the result is replicated and fetched once. The jit wrapper is
-    module-cached — a fresh ``jax.jit`` per call would retrace every epoch.
-    """
-    return _host_sum_jit(x)

@@ -46,13 +46,22 @@ class DistributedDataParallel:
         eval_transform: Optional[Callable] = None,
         remat: bool = False,
         weight_update_sharding: bool = False,
+        grad_accumulation: int = 1,
     ):
         """``weight_update_sharding``: shard the optimizer update + moments
         across the data axis (reduce-scatter grads, update a 1/N parameter
         shard per replica, all-gather new params — the cross-replica
         weight-update sharding of arxiv.org/abs/2004.13336 / ZeRO-1).
         N-fold less optimizer memory and update HBM traffic per chip; same
-        interconnect bytes as the plain allreduce. shard_map mode only."""
+        interconnect bytes as the plain allreduce. shard_map mode only.
+
+        ``grad_accumulation=A > 1``: ONE optimizer update per A consecutive
+        micro-batches (native effective-batch control, the explicit-API analog
+        of ``Accelerator(gradient_accumulation_steps=A)``). Training then runs
+        through :meth:`train_step_many` in whole cycles of A — the epoch
+        driver pads ragged tails with all-padding micro-batches; the
+        per-batch :meth:`train_step` is refused (a full-scale update per
+        micro-batch would be a silent A× LR bug)."""
         self.model = model
         self.optimizer = optimizer
         self.criterion = criterion if criterion is not None else CrossEntropyLoss()
@@ -68,6 +77,11 @@ class DistributedDataParallel:
                 "weight_update_sharding requires mode='shard_map' (the "
                 "reduce-scatter/all-gather exchange is expressed over the "
                 "explicit per-replica step's named axis)"
+            )
+        self.grad_accumulation = int(grad_accumulation)
+        if self.grad_accumulation < 1:
+            raise ValueError(
+                f"grad_accumulation must be >= 1, got {grad_accumulation!r}"
             )
         self.sync_buffers = sync_buffers
         self.clip_grad_norm = clip_grad_norm
@@ -207,10 +221,19 @@ class DistributedDataParallel:
                 remat=self.remat,
                 wus_spec=self._wus_spec,
                 state_spec=self._state_spec,
+                grad_accumulation=self.grad_accumulation,
             )
         return self._scan_step(state, stacked_batch)
 
     def train_step(self, state: TrainState, batch):
+        if self.grad_accumulation > 1:
+            raise RuntimeError(
+                "per-batch train_step is undefined under grad_accumulation "
+                f"(= {self.grad_accumulation}): it would apply one full-scale "
+                "update per micro-batch. Use train_step_many with chunks that "
+                "are whole multiples of the accumulation cycle (the epoch "
+                "driver does this automatically)."
+            )
         if self._train_step is None:
             self._check_wus_ready()
             self._train_step = step_lib.build_train_step(

@@ -76,13 +76,27 @@ def _param_bytes(params) -> int:
     )
 
 
+def _pad_to_cycles(chunk, accum: int):
+    """Pad a ragged tail chunk with all-padding (weight-0) micro-batches to a
+    whole number of accumulation cycles. Padding batches carry zero sample
+    weight, so they contribute nothing to gradients, metrics, or BatchNorm
+    statistics (nn/loss.py, nn/norm.py) — the cycle's update averages over
+    the live samples only."""
+    x0, y0, w0 = chunk[-1]
+    pad = (-len(chunk)) % accum
+    return chunk + [(x0, y0, np.zeros_like(w0))] * pad
+
+
 def _fused_pass(
-    ddp, state, loader, scan_k: int, step_one, step_many, probe_cb=None
+    ddp, state, loader, scan_k: int, step_one, step_many, probe_cb=None,
+    accum: int = 1,
 ):
     """One pass over ``loader`` with K-fused dispatch + one-chunk upload
     lookahead (device_put is async, so staging chunk N+1 before dispatching N
     overlaps host->HBM transfer with the previous dispatch's compute). Shared
     by the train and eval passes; ``step_*(state, batch) -> (state, metrics)``.
+    ``accum > 1``: chunks arrive at ``step_many`` as whole accumulation
+    cycles (``scan_k`` is a multiple of ``accum``; the ragged tail is padded).
     Returns ``(state, accumulated_metrics)``."""
     acc = None
     chunk = []
@@ -90,7 +104,7 @@ def _fused_pass(
     for batch_idx, host_batch in enumerate(loader):
         if probe_cb is not None:
             probe_cb(batch_idx, host_batch)
-        if scan_k <= 1:
+        if scan_k <= 1 and accum <= 1:
             state, metrics = step_one(state, ddp.shard(host_batch))
             acc = accumulate_metrics(acc, metrics)
             continue
@@ -105,6 +119,13 @@ def _fused_pass(
     if staged is not None:
         state, metrics = step_many(state, staged)
         acc = accumulate_metrics(acc, metrics)
+    if chunk and accum > 1:
+        # tail under accumulation: pad to whole cycles, one scan dispatch
+        # (a per-batch step would fire a full-scale update per micro-batch)
+        tail = _pad_to_cycles(chunk, accum)
+        state, metrics = step_many(state, ddp.shard_stacked(stack_batches(tail)))
+        acc = accumulate_metrics(acc, metrics)
+        return state, acc
     for host_batch in chunk:  # remainder: single steps, same semantics
         state, metrics = step_one(state, ddp.shard(host_batch))
         acc = accumulate_metrics(acc, metrics)
@@ -142,6 +163,11 @@ def run_training_loop(
         else 1
     )
     scan_steps = resolve_scan_steps(scan_steps, len(train_loader), pbytes)
+    accum = int(getattr(ddp, "grad_accumulation", 1) or 1)
+    if accum > 1:
+        # chunks must hold whole accumulation cycles: round K up to the
+        # cycle length, then down to a multiple of it
+        scan_steps = max(accum, (scan_steps // accum) * accum)
     history = []
     metrics_writer = MetricsWriter(save_dir)
     profiling = maybe_start_profiler(save_dir)  # $TPUDDP_PROFILE hook
@@ -177,6 +203,7 @@ def run_training_loop(
         state, train_acc = _fused_pass(
             ddp, state, train_loader, scan_steps,
             ddp.train_step, ddp.train_step_many, probe_cb=train_probe,
+            accum=accum,
         )
 
         # ---- eval pass (same K-fused dispatch + upload lookahead; without

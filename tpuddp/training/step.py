@@ -149,24 +149,19 @@ def _validate_sync_buffers(model, axis_name: Optional[str], sync_buffers: str):
             )
 
 
-def _make_train_core(
+def _make_grad_core(
     model,
     criterion,
-    optimizer,
     axis_name: Optional[str],
     sync_buffers: str,
-    clip_grad_norm: Optional[float],
     augment: Optional[Callable],
     remat: bool = False,
-    wus_spec: Optional[FlatParamSpec] = None,
 ):
-    _validate_sync_buffers(model, axis_name, sync_buffers)
-    if wus_spec is not None and axis_name is None:
-        raise ValueError(
-            "weight_update_sharding needs the explicit per-replica step "
-            "(mode='shard_map'): the reduce-scatter/all-gather exchange is "
-            "expressed over its named data axis"
-        )
+    """The forward+backward half of the train step: one micro-batch in,
+    ``(grads, synced_model_state, loss, n)`` out. Gradients are this replica's
+    LOCAL batch-mean gradient — cross-replica reduction belongs to the update
+    half (:func:`_make_update_fn`), so gradient accumulation can sum local
+    grads over K micro-batches and pay for ONE collective per cycle."""
     # Rematerialization: trade FLOPs for HBM by recomputing activations in the
     # backward pass (jax.checkpoint) — how large models/batches fit on-chip.
     apply_fn = model.apply
@@ -178,7 +173,7 @@ def _make_train_core(
             )
             return fn(params, mstate, x)
 
-    def core(state: TrainState, x, y, w):
+    def grad_core(state: TrainState, x, y, w):
         aug_rng, dropout_rng = _split_step_rng(state, axis_name)
         if augment is not None:
             x = augment(aug_rng, x)
@@ -197,6 +192,32 @@ def _make_train_core(
             state.params
         )
 
+        if axis_name is not None and sync_buffers == "broadcast":
+            # torch DDP's default broadcast_buffers=True: unsynced BN buffers
+            # follow rank 0. Synced BN already produced identical buffers.
+            model_state = col.broadcast(model_state, root=0, axis_name=axis_name)
+        elif axis_name is not None and sync_buffers == "pmean":
+            # average instead of rank-0-wins: every replica's statistics
+            # contribute (identical when BN is already synced)
+            model_state = col.pmean(model_state, axis_name)
+
+        return grads, model_state, loss, jnp.sum(w)
+
+    return grad_core
+
+
+def _make_update_fn(
+    optimizer,
+    axis_name: Optional[str],
+    clip_grad_norm: Optional[float],
+    wus_spec: Optional[FlatParamSpec],
+):
+    """The optimizer half of the train step: replica-local mean gradients in,
+    ``(new_params, new_opt_state)`` out. Owns the cross-replica exchange
+    (pmean, or reduce-scatter/all-gather under weight-update sharding) and the
+    clip-after-aggregate."""
+
+    def apply_update(params, opt_state, grads):
         if wus_spec is not None:
             # Weight-update sharding (the cross-replica weight-update recipe
             # of arxiv.org/abs/2004.13336, ZeRO-1's TPU-native shape): instead
@@ -229,42 +250,61 @@ def _make_train_core(
                     1.0, clip_grad_norm / (norm + 1e-6)
                 )
             idx = jax.lax.axis_index(axis_name)
-            p_vec = _tree_to_vec(state.params, wus_spec)
+            p_vec = _tree_to_vec(params, wus_spec)
             p_shard = jax.lax.dynamic_slice(
                 p_vec, (idx * shard_n,), (shard_n,)
             )
             new_p_shard, new_opt_state = optimizer.update(
-                g_shard, state.opt_state, p_shard
+                g_shard, opt_state, p_shard
             )
             new_p_vec = jax.lax.all_gather(
                 new_p_shard, axis_name, tiled=True
             )
-            new_params = _vec_to_tree(new_p_vec, wus_spec)
-        else:
-            if axis_name is not None:
-                # THE DDP step: average gradients across replicas (reference
-                # :125's implicit NCCL allreduce). In auto mode XLA inserts
-                # this itself.
-                grads = col.pmean(grads, axis_name)
-            if clip_grad_norm is not None:
-                # clip-before-aggregate caveat (reference README): clip the
-                # *averaged* grad, identically on all replicas.
-                grads, _ = _optim.clip_grad_norm_(grads, clip_grad_norm)
+            return _vec_to_tree(new_p_vec, wus_spec), new_opt_state
 
-            new_params, new_opt_state = optimizer.update(
-                grads, state.opt_state, state.params
-            )
+        if axis_name is not None:
+            # THE DDP step: average gradients across replicas (reference
+            # :125's implicit NCCL allreduce). In auto mode XLA inserts
+            # this itself.
+            grads = col.pmean(grads, axis_name)
+        if clip_grad_norm is not None:
+            # clip-before-aggregate caveat (reference README): clip the
+            # *averaged* grad, identically on all replicas.
+            grads, _ = _optim.clip_grad_norm_(grads, clip_grad_norm)
 
-        if axis_name is not None and sync_buffers == "broadcast":
-            # torch DDP's default broadcast_buffers=True: unsynced BN buffers
-            # follow rank 0. Synced BN already produced identical buffers.
-            model_state = col.broadcast(model_state, root=0, axis_name=axis_name)
-        elif axis_name is not None and sync_buffers == "pmean":
-            # average instead of rank-0-wins: every replica's statistics
-            # contribute (identical when BN is already synced)
-            model_state = col.pmean(model_state, axis_name)
+        return optimizer.update(grads, opt_state, params)
 
-        n = jnp.sum(w)
+    return apply_update
+
+
+def _make_train_core(
+    model,
+    criterion,
+    optimizer,
+    axis_name: Optional[str],
+    sync_buffers: str,
+    clip_grad_norm: Optional[float],
+    augment: Optional[Callable],
+    remat: bool = False,
+    wus_spec: Optional[FlatParamSpec] = None,
+):
+    _validate_sync_buffers(model, axis_name, sync_buffers)
+    if wus_spec is not None and axis_name is None:
+        raise ValueError(
+            "weight_update_sharding needs the explicit per-replica step "
+            "(mode='shard_map'): the reduce-scatter/all-gather exchange is "
+            "expressed over its named data axis"
+        )
+    grad_core = _make_grad_core(
+        model, criterion, axis_name, sync_buffers, augment, remat
+    )
+    apply_update = _make_update_fn(optimizer, axis_name, clip_grad_norm, wus_spec)
+
+    def core(state: TrainState, x, y, w):
+        grads, model_state, loss, n = grad_core(state, x, y, w)
+        new_params, new_opt_state = apply_update(
+            state.params, state.opt_state, grads
+        )
         metrics = {
             "loss_sum": (loss * n)[None],  # sample-weighted, reference :131
             "n": n[None],
@@ -364,6 +404,7 @@ def build_train_scan_step(
     remat: bool = False,
     wus_spec: Optional[FlatParamSpec] = None,
     state_spec=None,
+    grad_accumulation: int = 1,
 ):
     """Multi-step variant: runs K train steps per jit call via ``lax.scan``.
 
@@ -374,6 +415,17 @@ def build_train_scan_step(
     dispatch-bound runtimes this is the difference between RPC-bound and
     MXU-bound throughput. K is static per compilation (one cache entry per
     distinct K, so group epochs into fixed-size chunks).
+
+    ``grad_accumulation=A > 1`` turns every A consecutive micro-batches into
+    ONE optimizer update (effective-batch control, the native analog of the
+    managed path's ``gradient_accumulation_steps`` — reference
+    multi-GPU-training-torch.py:88's batch size knob): the scan is
+    restructured as cycles of A micro-batches whose sample-weighted gradient
+    sums accumulate in the carry; the cycle boundary pays ONE cross-replica
+    exchange + clip + update on the n-weighted average — exactly the gradient
+    of one step over the A micro-batches' concatenation (all-padding
+    micro-batches contribute nothing, so tails can be padded to a static
+    cycle length). K must be a multiple of A.
     """
     if mode == "shard_map":
         axis_name, in_batch = DATA_AXIS, P(None, DATA_AXIS)
@@ -383,20 +435,104 @@ def build_train_scan_step(
     else:
         raise ValueError(f"unknown mode {mode!r}; one of 'shard_map', 'auto'")
 
-    core = _make_train_core(
-        model, criterion, optimizer, axis_name, sync_buffers,
-        clip_grad_norm, augment, remat, wus_spec=wus_spec,
-    )
+    accum = int(grad_accumulation)
+    if accum < 1:
+        raise ValueError(f"grad_accumulation must be >= 1, got {grad_accumulation!r}")
+    _validate_sync_buffers(model, axis_name, sync_buffers)
+    if wus_spec is not None and axis_name is None:
+        raise ValueError(
+            "weight_update_sharding needs the explicit per-replica step "
+            "(mode='shard_map'): the reduce-scatter/all-gather exchange is "
+            "expressed over its named data axis"
+        )
 
-    def multi(state: TrainState, xs, ys, ws):
-        def body(st, batch):
-            x, y, w = batch
-            st, m = core(st, x, y, w)
-            return st, m
+    if accum == 1:
+        core = _make_train_core(
+            model, criterion, optimizer, axis_name, sync_buffers,
+            clip_grad_norm, augment, remat, wus_spec=wus_spec,
+        )
 
-        state, stacked = jax.lax.scan(body, state, (xs, ys, ws))
-        metrics = jax.tree_util.tree_map(lambda a: jnp.sum(a, axis=0), stacked)
-        return state, metrics
+        def multi(state: TrainState, xs, ys, ws):
+            def body(st, batch):
+                x, y, w = batch
+                st, m = core(st, x, y, w)
+                return st, m
+
+            state, stacked = jax.lax.scan(body, state, (xs, ys, ws))
+            metrics = jax.tree_util.tree_map(lambda a: jnp.sum(a, axis=0), stacked)
+            return state, metrics
+    else:
+        grad_core = _make_grad_core(
+            model, criterion, axis_name, sync_buffers, augment, remat
+        )
+        apply_update = _make_update_fn(
+            optimizer, axis_name, clip_grad_norm, wus_spec
+        )
+
+        def multi(state: TrainState, xs, ys, ws):
+            k = xs.shape[0]
+            if k % accum != 0:
+                raise ValueError(
+                    f"scan length {k} is not a multiple of "
+                    f"grad_accumulation={accum}; pad the chunk to a whole "
+                    "number of accumulation cycles (training/loop.py does "
+                    "this with all-padding micro-batches)"
+                )
+            cyc = (
+                xs.reshape(k // accum, accum, *xs.shape[1:]),
+                ys.reshape(k // accum, accum, *ys.shape[1:]),
+                ws.reshape(k // accum, accum, *ws.shape[1:]),
+            )
+
+            def cycle(st, cyc_batch):
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, st.params)
+
+                def micro(carry, mb):
+                    st, gacc, nacc = carry
+                    x, y, w = mb
+                    grads, model_state, loss, n = grad_core(st, x, y, w)
+                    # n-weighted gradient sum: micro-batch i's local grad is
+                    # the mean over its n_i live samples, so Σ n_i·g_i / Σ n_i
+                    # is EXACTLY the mean gradient of the concatenated batch,
+                    # padded/ragged micro-batches included
+                    gacc = jax.tree_util.tree_map(
+                        lambda a, g: a + n * g, gacc, grads
+                    )
+                    st = TrainState(
+                        params=st.params,
+                        model_state=model_state,
+                        opt_state=st.opt_state,
+                        step=st.step + 1,
+                        rng=st.rng,
+                    )
+                    m = {"loss_sum": (loss * n)[None], "n": n[None]}
+                    return (st, gacc, nacc + n), m
+
+                (st, gacc, nacc), stacked = jax.lax.scan(
+                    micro, (st, zeros, jnp.zeros((), jnp.float32)), cyc_batch
+                )
+                # exact weighted mean even for fractional sample weights
+                # (guard only the all-padding nacc==0 case, like nn/loss.py)
+                denom = jnp.where(nacc == 0, 1.0, nacc)
+                g = jax.tree_util.tree_map(lambda a: a / denom, gacc)
+                new_params, new_opt_state = apply_update(
+                    st.params, st.opt_state, g
+                )
+                st = TrainState(
+                    params=new_params,
+                    model_state=st.model_state,
+                    opt_state=new_opt_state,
+                    step=st.step,
+                    rng=st.rng,
+                )
+                metrics = jax.tree_util.tree_map(
+                    lambda a: jnp.sum(a, axis=0), stacked
+                )
+                return st, metrics
+
+            state, stacked = jax.lax.scan(cycle, state, cyc)
+            metrics = jax.tree_util.tree_map(lambda a: jnp.sum(a, axis=0), stacked)
+            return state, metrics
 
     if mode == "shard_map":
         st_spec = state_spec if state_spec is not None else P()

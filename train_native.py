@@ -101,13 +101,6 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
     if training.get("sync_bn"):
         nn.convert_sync_batchnorm(model)
 
-    if int(training.get("gradient_accumulation_steps") or 1) > 1:
-        raise ValueError(
-            "gradient_accumulation_steps is a managed-path "
-            "(train_accelerate.py) feature; the native path reaches large "
-            "effective batches directly via train_batch_size"
-        )
-
     # Loss + optimizer (reference :248-249). optimizer_state_dtype: bfloat16
     # stores Adam m/v in bf16 (f32 math, f32 master params) — halves the
     # optimizer HBM traffic that dominates FC-heavy steps (BASELINE.md).
@@ -132,6 +125,10 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         remat=bool(training.get("remat", False)),
         clip_grad_norm=float(clip) if clip is not None else None,
         weight_update_sharding=bool(training.get("weight_update_sharding", False)),
+        # effective-batch control (reference multi-GPU-training-torch.py:88's
+        # batch-size knob): one optimizer update per A micro-batches, fused
+        # into the scan step — same knob name as the managed path
+        grad_accumulation=int(training.get("gradient_accumulation_steps") or 1),
     )
     in_hw = size if size else train_ds.images.shape[1]
     state = ddp.init_state(
