@@ -38,7 +38,7 @@ TRAINING_DEFAULTS = {
     "mode": "shard_map",
     "sync_bn": False,
     "scan_steps": "auto",  # K train steps fused per dispatch (lax.scan);
-    # "auto" = size-resolved: up to 64 for sub-4MB models, 16 otherwise
+    # "auto" = size-resolved: up to 64 for sub-4MB models, 32 otherwise
     "clip_grad_norm": None,  # clip the cross-replica-AVERAGED grad (README's
     # clip-before-aggregate caveat: clipping per-shard grads then averaging
     # would differ; tpuddp clips after the pmean, identically on all replicas)

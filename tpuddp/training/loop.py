@@ -38,9 +38,11 @@ from tpuddp.utils.observability import (
 logger = logging.getLogger("tpuddp")
 
 
-_AUTO_SCAN_CAP = 16  # A/B-measured on AlexNet b128: K=16 is ~3% faster than
-# K=8 (7.11 vs 7.33 ms/step, dispatch amortization), and this is the depth
-# the bench's CNN rows publish — the product default and the bench agree
+_AUTO_SCAN_CAP = 32  # A/B-measured on AlexNet b128 across sessions: K=32 beat
+# K=16 in every pairing (r4 session: K=16 ~3% over K=8; r5 session with a
+# slow tunnel: 19.6 vs 21.8 ms/step — halving the per-dispatch RTT share is
+# pure amortization with no semantic cost). This is the depth the bench's
+# CNN rows publish — the product default and the bench agree
 _AUTO_SCAN_CAP_SMALL = 64  # dispatch-bound models: see resolve_scan_steps
 _SMALL_PARAM_BYTES = 4 * 1024 * 1024
 
@@ -48,7 +50,7 @@ _SMALL_PARAM_BYTES = 4 * 1024 * 1024
 def resolve_scan_steps(scan_steps, n_batches: int, param_bytes=None) -> int:
     """Resolve the per-dispatch fusion factor K.
 
-    ``"auto"`` (the default) fuses up to 16 batches per dispatch when the
+    ``"auto"`` (the default) fuses up to 32 batches per dispatch when the
     epoch has at least that many — the measured per-dispatch runtime latency
     dominates per-step time otherwise (BASELINE.md: ~7x on the toy model
     through a tunneled TPU). For *small* models (whole parameter set under
