@@ -573,8 +573,9 @@ def main():
         # param+grad HBM traffic over 4x the samples
         ("alexnet bf16 224 b512 bf16-opt (scan-fused)", bf16_alexnet, 512, 8,
          24, bf16_opt),
-        # the measured sweet spot: with the s2d stem, b256 already reaches
-        # b512-level MFU (~42%) at half the per-chip batch
+        # the measured sweet spot: with the s2d stem, b256 matches-or-beats
+        # the b512 row at half the per-chip batch (same-session artifact
+        # pair, BENCH_r04.json: 39.3% vs 38.0%)
         ("alexnet bf16 224 b256 bf16-opt s2d (scan-fused)",
          lambda: (AlexNet(10, space_to_depth=True),
                   make_train_augment(size=224, compute_dtype=jnp.bfloat16)),
@@ -583,6 +584,18 @@ def main():
          lambda: cifar_resnet(ResNet18), 128, 32, 96, None),
         ("resnet34 bf16 32x32 sync-BN (scan-fused)",
          lambda: cifar_resnet(ResNet34), 128, 32, 64, None),
+        # the full-resolution reference-class CNN (data_and_toy_model.py:13-36
+        # is 224x224): profile-backed accounting in BASELINE.md "Where the
+        # time goes (ResNet-18@224)"; s2d = exact 7x7/s2 stem
+        # reparameterization (resnet18_s2d)
+        ("resnet18 bf16 224 b128 bf16-opt (scan-fused)",
+         lambda: (ResNet18(10),
+                  make_train_augment(size=224, compute_dtype=jnp.bfloat16)),
+         128, 32, 64, bf16_opt),
+        ("resnet18 bf16 224 b128 bf16-opt s2d (scan-fused)",
+         lambda: (ResNet18(10, space_to_depth=True),
+                  make_train_augment(size=224, compute_dtype=jnp.bfloat16)),
+         128, 32, 64, bf16_opt),
     ]
     for name, make, batch, scan, steps, opt in cnn_configs:
         try:  # diagnostics only — independent, and never break the headline line
