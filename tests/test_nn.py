@@ -63,7 +63,7 @@ def test_maxpool_matches_torch():
     np.testing.assert_allclose(np.asarray(y), ref.transpose(0, 2, 3, 1), rtol=1e-6)
 
 
-@pytest.mark.parametrize("in_hw,out_hw", [(13, 6), (7, 7), (8, 4), (5, 3)])
+@pytest.mark.parametrize("in_hw,out_hw", [(13, 6), (7, 7), (8, 4), (5, 3), (1, 2)])
 def test_adaptive_avg_pool_matches_torch(in_hw, out_hw):
     x = np.random.RandomState(2).randn(2, in_hw, in_hw, 3).astype(np.float32)
     layer = nn.AdaptiveAvgPool2d(out_hw)
@@ -72,6 +72,22 @@ def test_adaptive_avg_pool_matches_torch(in_hw, out_hw):
         torch.from_numpy(x.transpose(0, 3, 1, 2)), out_hw
     ).numpy().transpose(0, 2, 3, 1)
     np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("in_hw,out_hw", [(13, 6), (5, 3)])
+def test_adaptive_avg_pool_gradient_matches_torch(in_hw, out_hw):
+    # (13,6) takes the uniform-bin reduce_window fast path, (5,3) the ragged
+    # integral-image path (nn/layers.py) — both backwards must match torch
+    x = np.random.RandomState(3).randn(2, in_hw, in_hw, 3).astype(np.float32)
+    layer = nn.AdaptiveAvgPool2d(out_hw)
+    g = jax.grad(
+        lambda v: jnp.sum(layer.apply((), (), v, nn.Context())[0] ** 2)
+    )(jnp.asarray(x))
+    xt = torch.from_numpy(x.transpose(0, 3, 1, 2)).requires_grad_(True)
+    F.adaptive_avg_pool2d(xt, out_hw).pow(2).sum().backward()
+    np.testing.assert_allclose(
+        np.asarray(g), xt.grad.numpy().transpose(0, 2, 3, 1), rtol=1e-4, atol=1e-5
+    )
 
 
 def test_dropout_train_eval_and_rng():
