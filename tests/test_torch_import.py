@@ -393,3 +393,129 @@ def test_pretrained_vgg11_head_swap_from_config(tmp_path):
     assert params[-1]["weight"].shape == (4096, 10)
     conv0 = donor.state_dict()["features.0.weight"].numpy().transpose(2, 3, 1, 0)
     np.testing.assert_allclose(np.asarray(params[0]["weight"]), conv0, rtol=1e-6)
+
+
+class _TorchBottleneck(tnn.Module):
+    def __init__(self, in_ch, width, stride=1):
+        super().__init__()
+        out_ch = width * 4
+        self.conv1 = tnn.Conv2d(in_ch, width, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        self.conv2 = tnn.Conv2d(width, width, 3, stride, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(width)
+        self.conv3 = tnn.Conv2d(width, out_ch, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(out_ch)
+        self.downsample = None
+        if stride != 1 or in_ch != out_ch:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(in_ch, out_ch, 1, stride, bias=False),
+                tnn.BatchNorm2d(out_ch),
+            )
+
+    def forward(self, x):
+        idn = x if self.downsample is None else self.downsample(x)
+        h = torch.relu(self.bn1(self.conv1(x)))
+        h = torch.relu(self.bn2(self.conv2(h)))
+        h = self.bn3(self.conv3(h))
+        return torch.relu(h + idn)
+
+
+class _TorchResNet50(tnn.Module):
+    """Hand-built torchvision-layout Bottleneck ResNet-50 (v1.5 stride
+    placement: the 3x3 conv strides); state_dict keys match torchvision's
+    by attribute naming."""
+
+    def __init__(self, num_classes=1000, depths=(3, 4, 6, 3)):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        widths = [64, 128, 256, 512]
+        in_ch = 64
+        for i, (w, n) in enumerate(zip(widths, depths), start=1):
+            stride = 1 if i == 1 else 2
+            blocks = [_TorchBottleneck(in_ch, w, stride)]
+            blocks.extend(_TorchBottleneck(w * 4, w) for _ in range(n - 1))
+            setattr(self, f"layer{i}", tnn.Sequential(*blocks))
+            in_ch = w * 4
+        self.avgpool = tnn.AdaptiveAvgPool2d(1)
+        self.fc = tnn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        h = self.maxpool(torch.relu(self.bn1(self.conv1(x))))
+        for i in (1, 2, 3, 4):
+            h = getattr(self, f"layer{i}")(h)
+        return self.fc(torch.flatten(self.avgpool(h), 1))
+
+
+@pytest.mark.slow
+def test_imported_resnet50_reproduces_torch_logits():
+    """Converted torchvision-layout ResNet-50 (Bottleneck) weights + BN
+    running stats must reproduce the torch model's eval-mode logits."""
+    from tpuddp.models import ResNet50
+    from tpuddp.models.torch_import import convert_resnet_bottleneck_state_dict
+    from tpuddp.nn.core import Context
+
+    torch.manual_seed(11)
+    donor = _TorchResNet50(num_classes=1000)
+    donor.train()
+    with torch.no_grad():
+        for _ in range(2):
+            donor(torch.randn(2, 3, 64, 64))
+    donor.eval()
+
+    model = ResNet50(num_classes=1000)
+    params, mstate = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    params, mstate = convert_resnet_bottleneck_state_dict(
+        donor.state_dict(), params, mstate
+    )
+
+    x = np.random.RandomState(1).randn(2, 64, 64, 3).astype(np.float32)
+    ours, _ = model.apply(params, mstate, jnp.asarray(x), Context(train=False))
+    with torch.no_grad():
+        ref = donor(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_pretrained_resnet50_head_swap_and_s2d(tmp_path):
+    """load_pretrained_resnet50: 1000-class donor checkpoint -> 10-class
+    head-swapped model; the s2d variant loads the SAME checkpoint and
+    produces the same logits (exact stem reparameterization)."""
+    from tpuddp.models.torch_import import load_pretrained_resnet50
+    from tpuddp.nn.core import Context
+
+    torch.manual_seed(12)
+    donor = _TorchResNet50(num_classes=1000)
+    path = tmp_path / "rn50.pt"
+    torch.save(donor.state_dict(), path)
+
+    key = jax.random.key(5)
+    model, params, mstate = load_pretrained_resnet50(str(path), key, num_classes=10)
+    x = np.random.RandomState(2).randn(2, 64, 64, 3).astype(np.float32)
+    logits, _ = model.apply(params, mstate, jnp.asarray(x), Context(train=False))
+    assert logits.shape == (2, 10)
+
+    s2d_model, s2d_params, s2d_state = load_pretrained_resnet50(
+        str(path), key, num_classes=10, space_to_depth=True
+    )
+    s2d_logits, _ = s2d_model.apply(
+        s2d_params, s2d_state, jnp.asarray(x), Context(train=False)
+    )
+    np.testing.assert_allclose(
+        np.asarray(s2d_logits), np.asarray(logits), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_resnet50_import_rejects_resnet18_checkpoint(tmp_path):
+    """A BasicBlock checkpoint fed to the Bottleneck converter must be
+    refused loudly (missing conv3/bn3 tensors), not silently mis-mapped."""
+    from tpuddp.models import ResNet50
+    from tpuddp.models.torch_import import convert_resnet_bottleneck_state_dict
+
+    torch.manual_seed(13)
+    donor18 = _TorchResNet18(num_classes=10)
+    model = ResNet50(num_classes=10)
+    params, mstate = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    with pytest.raises((ValueError, KeyError)):
+        convert_resnet_bottleneck_state_dict(donor18.state_dict(), params, mstate)

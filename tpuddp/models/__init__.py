@@ -1,11 +1,12 @@
 """Model zoo. The reference's zoo is ``load_model`` = pretrained AlexNet with
 its classifier head swapped for CIFAR-10 (data_and_toy_model.py:41-45); tpuddp
-adds genuinely small toy models for fast CI (per SURVEY.md scale calibration)
-and a ResNet-18 for the multi-host BASELINE config."""
+adds genuinely small toy models for fast CI (per SURVEY.md scale calibration),
+ResNet-18/34 (BasicBlock) + ResNet-50 (Bottleneck), VGG-11/13/16, and
+CIFAR-stem/space-to-depth variants; all torch-importable."""
 
 from tpuddp.models.toy import ToyCNN, ToyMLP  # noqa: F401
 from tpuddp.models.alexnet import AlexNet  # noqa: F401
-from tpuddp.models.resnet import ResNet18, ResNet34  # noqa: F401
+from tpuddp.models.resnet import ResNet18, ResNet34, ResNet50  # noqa: F401
 from tpuddp.models.vgg import VGG11, VGG13, VGG16  # noqa: F401
 
 from functools import partial as _partial
@@ -16,17 +17,20 @@ _REGISTRY = {
     "alexnet": AlexNet,
     "resnet18": ResNet18,
     "resnet34": ResNet34,
+    "resnet50": ResNet50,
     "vgg11": VGG11,
     "vgg13": VGG13,
     "vgg16": VGG16,
     # CIFAR-style stem (3x3 conv, no maxpool) for small native resolutions
     "resnet18_small": _partial(ResNet18, small_input=True),
     "resnet34_small": _partial(ResNet34, small_input=True),
+    "resnet50_small": _partial(ResNet50, small_input=True),
     # exact space-to-depth stem reparameterization (same params/checkpoints;
     # faster MXU mapping for the thin-channel strided stems)
     "alexnet_s2d": _partial(AlexNet, space_to_depth=True),
     "resnet18_s2d": _partial(ResNet18, space_to_depth=True),
     "resnet34_s2d": _partial(ResNet34, space_to_depth=True),
+    "resnet50_s2d": _partial(ResNet50, space_to_depth=True),
 }
 
 
@@ -40,7 +44,7 @@ def load_model(name: str = "alexnet", num_classes: int = 10, **kwargs):
 
 
 __all__ = [
-    "ToyMLP", "ToyCNN", "AlexNet", "ResNet18", "ResNet34",
+    "ToyMLP", "ToyCNN", "AlexNet", "ResNet18", "ResNet34", "ResNet50",
     "VGG11", "VGG13", "VGG16",
     "load_model",
 ]

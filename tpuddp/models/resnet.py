@@ -1,5 +1,5 @@
-"""ResNets (NHWC) — ResNet-18 for the multi-host CIFAR BASELINE config
-(BASELINE.json configs[4]) and ResNet-34 (same BasicBlock, deeper stages).
+"""ResNets (NHWC) — ResNet-18/34 (BasicBlock; -18 is the multi-host CIFAR
+BASELINE config, BASELINE.json configs[4]) and ResNet-50 (Bottleneck).
 BatchNorm layers honor convert_sync_batchnorm / ``sync_bn=True`` so
 cross-replica statistic sync works under DP."""
 
@@ -60,6 +60,72 @@ class BasicBlock(Module):
         return jax.nn.relu(h + sc), new_state
 
 
+class Bottleneck(Module):
+    """1x1 reduce -> 3x3 (strided, torchvision v1.5 placement) -> 1x1 expand
+    (x4), with identity (or 1x1-projected) shortcut — the ResNet-50/101/152
+    block (torchvision-layout state_dict keys: conv1/bn1, conv2/bn2,
+    conv3/bn3, downsample.{0,1})."""
+
+    expansion = 4
+
+    def __init__(self, features: int, stride: int = 1, sync_bn: bool = False):
+        self.features = features  # the bottleneck width; output is 4x
+        self.stride = stride
+        self.conv1 = nn.Conv2d(features, 1, use_bias=False)
+        self.bn1 = nn.BatchNorm(sync=sync_bn)
+        self.conv2 = nn.Conv2d(features, 3, strides=stride, padding=1, use_bias=False)
+        self.bn2 = nn.BatchNorm(sync=sync_bn)
+        self.conv3 = nn.Conv2d(features * self.expansion, 1, use_bias=False)
+        self.bn3 = nn.BatchNorm(sync=sync_bn)
+        self.down_conv = nn.Conv2d(
+            features * self.expansion, 1, strides=stride, use_bias=False
+        )
+        self.down_bn = nn.BatchNorm(sync=sync_bn)
+
+    def children(self):
+        return (
+            self.conv1, self.bn1, self.conv2, self.bn2, self.conv3, self.bn3,
+            self.down_conv, self.down_bn,
+        )
+
+    def divergent_state(self) -> bool:
+        return False  # aggregates child state only; owns no buffers of its own
+
+    def init(self, key, x):
+        keys = jax.random.split(key, 8)
+        in_ch = x.shape[-1]
+        p, s = {}, {}
+        p["conv1"], _, h = self.conv1.init_with_output_shape(keys[0], x)
+        p["bn1"], s["bn1"], h = self.bn1.init_with_output_shape(keys[1], h)
+        p["conv2"], _, h = self.conv2.init_with_output_shape(keys[2], h)
+        p["bn2"], s["bn2"], h = self.bn2.init_with_output_shape(keys[3], h)
+        p["conv3"], _, h = self.conv3.init_with_output_shape(keys[4], h)
+        p["bn3"], s["bn3"], _ = self.bn3.init_with_output_shape(keys[5], h)
+        if self.stride != 1 or in_ch != self.features * self.expansion:
+            p["down_conv"], _, d = self.down_conv.init_with_output_shape(keys[6], x)
+            p["down_bn"], s["down_bn"], _ = self.down_bn.init_with_output_shape(keys[7], d)
+        return p, s
+
+    def apply(self, params, state, x, ctx: Context):
+        new_state = dict(state)
+        h, _ = self.conv1.apply(params["conv1"], (), x, ctx)
+        h, new_state["bn1"] = self.bn1.apply(params["bn1"], state["bn1"], h, ctx)
+        h = jax.nn.relu(h)
+        h, _ = self.conv2.apply(params["conv2"], (), h, ctx)
+        h, new_state["bn2"] = self.bn2.apply(params["bn2"], state["bn2"], h, ctx)
+        h = jax.nn.relu(h)
+        h, _ = self.conv3.apply(params["conv3"], (), h, ctx)
+        h, new_state["bn3"] = self.bn3.apply(params["bn3"], state["bn3"], h, ctx)
+        if "down_conv" in params:
+            sc, _ = self.down_conv.apply(params["down_conv"], (), x, ctx)
+            sc, new_state["down_bn"] = self.down_bn.apply(
+                params["down_bn"], state["down_bn"], sc, ctx
+            )
+        else:
+            sc = x
+        return jax.nn.relu(h + sc), new_state
+
+
 class GlobalAvgPool(Module):
     def apply(self, params, state, x, ctx: Context):
         return x.mean(axis=(1, 2)), state
@@ -71,13 +137,15 @@ def _resnet(
     sync_bn: bool,
     small_input: bool,
     space_to_depth: bool = False,
+    block=BasicBlock,
 ) -> nn.Sequential:
-    """stem + BasicBlock stages at widths [64,128,256,512] + GAP head.
+    """stem + ``block`` stages at widths [64,128,256,512] + GAP head.
     ``small_input=True`` uses the CIFAR stem (3x3/1 conv, no maxpool) for
     native 32x32 training — the TPU-friendly alternative to the reference's
     resize-everything-to-224. ``space_to_depth=True`` swaps the full stem's
     7x7/s2 3-channel conv for its exact space-to-depth reparameterization
-    (same parameters/checkpoints; see nn.SpaceToDepthConv2d)."""
+    (same parameters/checkpoints; see nn.SpaceToDepthConv2d). ``block`` is
+    BasicBlock (ResNet-18/34) or Bottleneck (ResNet-50)."""
     if small_input:
         if space_to_depth:
             raise ValueError(
@@ -101,9 +169,9 @@ def _resnet(
     for n_blocks, (width, stride) in zip(
         depths, [(64, 1), (128, 2), (256, 2), (512, 2)]
     ):
-        blocks.append(BasicBlock(width, stride=stride, sync_bn=sync_bn))
+        blocks.append(block(width, stride=stride, sync_bn=sync_bn))
         blocks.extend(
-            BasicBlock(width, stride=1, sync_bn=sync_bn)
+            block(width, stride=1, sync_bn=sync_bn)
             for _ in range(n_blocks - 1)
         )
     head = [GlobalAvgPool(), nn.Linear(num_classes)]
@@ -124,3 +192,15 @@ def ResNet34(
 ) -> nn.Sequential:
     """Standard ResNet-34: [3,4,6,3] BasicBlocks."""
     return _resnet((3, 4, 6, 3), num_classes, sync_bn, small_input, space_to_depth)
+
+
+def ResNet50(
+    num_classes: int = 10, sync_bn: bool = False, small_input: bool = False,
+    space_to_depth: bool = False,
+) -> nn.Sequential:
+    """Standard ResNet-50: [3,4,6,3] Bottleneck blocks (torchvision v1.5
+    stride placement: the 3x3 conv strides)."""
+    return _resnet(
+        (3, 4, 6, 3), num_classes, sync_bn, small_input, space_to_depth,
+        block=Bottleneck,
+    )

@@ -1,4 +1,5 @@
-"""Import torch/torchvision checkpoints into tpuddp models (AlexNet, ResNet-18).
+"""Import torch/torchvision checkpoints into tpuddp models (AlexNet,
+VGG-11/13/16, ResNet-18/34/50).
 
 The reference starts from *pretrained* torchvision AlexNet weights
 (data_and_toy_model.py:41-43). This build runs zero-egress, so pretrained
@@ -221,15 +222,18 @@ def _checked(tag: str, new: Dict, expect) -> Dict:
     return new
 
 
-def convert_resnet_basic_state_dict(
-    state_dict: Mapping[str, object], params, model_state, depths=(2, 2, 2, 2)
+def _convert_resnet_state_dict(
+    state_dict: Mapping[str, object], params, model_state, depths, n_convs: int
 ):
-    """Map a torchvision-layout BasicBlock ResNet ``state_dict`` (conv1/bn1,
-    layer{1-4}.{block}.*, fc) onto tpuddp's full-stem ResNet Sequential
-    (tpuddp/models/resnet.py), for any stage ``depths`` — (2,2,2,2) is
-    ResNet-18, (3,4,6,3) is ResNet-34. Returns ``(params, model_state)`` —
-    unlike AlexNet, ResNet carries BatchNorm running statistics in the model
-    state, which must ride along for eval-mode parity."""
+    """Shared torchvision-layout ResNet converter (conv1/bn1 stem,
+    layer{1-4}.{block}.conv{1..n_convs}/bn{1..n_convs} (+downsample), fc)
+    onto tpuddp's full-stem ResNet Sequential (tpuddp/models/resnet.py).
+    ``n_convs=2`` is the BasicBlock family (ResNet-18/34), ``n_convs=3`` the
+    Bottleneck family (ResNet-50). Returns ``(params, model_state)`` — unlike
+    AlexNet, ResNet carries BatchNorm running statistics in the model state,
+    which must ride along for eval-mode parity. Strictness both ways: every
+    tensor the model expects must be in the checkpoint, and every checkpoint
+    tensor must be consumed."""
     consumed: set = set()
 
     class _Recording(dict):
@@ -247,28 +251,22 @@ def convert_resnet_basic_state_dict(
     bn_p, bn_s = _bn(state_dict, "bn1")
     new_p[1] = _checked("bn1", bn_p, new_p[1])
     new_s[1] = _checked("bn1(state)", bn_s, new_s[1])
-    base = 4  # first BasicBlock index in the full-stem Sequential
-    idx = base
+    idx = 4  # first block index in the full-stem Sequential
     for stage, n_blocks in zip((1, 2, 3, 4), depths):
         for block in range(n_blocks):
             t = f"layer{stage}.{block}"
-            p = {
-                "conv1": {"weight": _conv_w(state_dict, f"{t}.conv1")},
-                "conv2": {"weight": _conv_w(state_dict, f"{t}.conv2")},
-            }
-            s = {}
-            p["bn1"], s["bn1"] = _bn(state_dict, f"{t}.bn1")
-            p["bn2"], s["bn2"] = _bn(state_dict, f"{t}.bn2")
+            p, s = {}, {}
+            for i in range(1, n_convs + 1):
+                p[f"conv{i}"] = {"weight": _conv_w(state_dict, f"{t}.conv{i}")}
+                p[f"bn{i}"], s[f"bn{i}"] = _bn(state_dict, f"{t}.bn{i}")
             if f"{t}.downsample.0.weight" in state_dict:
                 p["down_conv"] = {"weight": _conv_w(state_dict, f"{t}.downsample.0")}
                 p["down_bn"], s["down_bn"] = _bn(state_dict, f"{t}.downsample.1")
-            missing_p = set(new_p[idx]) - set(p)
-            missing_s = set(new_s[idx]) - set(s)
-            if missing_p or missing_s:
+            missing = (set(new_p[idx]) - set(p)) | (set(new_s[idx]) - set(s))
+            if missing:
                 raise ValueError(
-                    f"{t}: checkpoint lacks expected tensors "
-                    f"{sorted(missing_p | missing_s)} (truncated file or a "
-                    "different shortcut variant)"
+                    f"{t}: checkpoint lacks expected tensors {sorted(missing)} "
+                    "(truncated file or a different shortcut variant)"
                 )
             new_p[idx] = _checked(t, p, new_p[idx])
             new_s[idx] = _checked(f"{t}(state)", s, new_s[idx])
@@ -289,10 +287,25 @@ def convert_resnet_basic_state_dict(
     if leftover:
         raise ValueError(
             f"checkpoint has {len(leftover)} tensors this ResNet{depths} "
-            f"layout does not consume (e.g. {leftover[:3]}); wrong "
-            "architecture?"
+            f"({n_convs}-conv block) layout does not consume (e.g. "
+            f"{leftover[:3]}); wrong architecture?"
         )
     return tuple(new_p), tuple(new_s)
+
+
+def convert_resnet_basic_state_dict(
+    state_dict: Mapping[str, object], params, model_state, depths=(2, 2, 2, 2)
+):
+    """BasicBlock-family converter — (2,2,2,2) is ResNet-18, (3,4,6,3) is
+    ResNet-34."""
+    return _convert_resnet_state_dict(state_dict, params, model_state, depths, 2)
+
+
+def convert_resnet_bottleneck_state_dict(
+    state_dict: Mapping[str, object], params, model_state, depths=(3, 4, 6, 3)
+):
+    """Bottleneck-family converter — (3,4,6,3) is ResNet-50."""
+    return _convert_resnet_state_dict(state_dict, params, model_state, depths, 3)
 
 
 def convert_resnet18_state_dict(state_dict: Mapping[str, object], params, model_state):
@@ -345,6 +358,22 @@ def load_pretrained_resnet34(
     )
 
 
+def load_pretrained_resnet50(
+    path: str, key, num_classes: int = 10, image_size: int = 224,
+    space_to_depth: bool = False,
+):
+    """ResNet-50 analog — [3,4,6,3] Bottleneck blocks (2048-wide head)."""
+    from tpuddp.models.resnet import ResNet50
+
+    return _load_pretrained(
+        path, key, num_classes, image_size,
+        build=lambda n: ResNet50(num_classes=n, space_to_depth=space_to_depth),
+        head_weight_key="fc.weight",
+        convert=convert_resnet_bottleneck_state_dict,
+        salt=0x9eb,
+    )
+
+
 def load_pretrained_vgg(
     name: str, path: str, key, num_classes: int = 10, image_size: int = 224
 ):
@@ -367,6 +396,7 @@ _PRETRAINED_LOADERS = {
     "alexnet": load_pretrained_alexnet,
     "resnet18": load_pretrained_resnet18,
     "resnet34": load_pretrained_resnet34,
+    "resnet50": load_pretrained_resnet50,
     "vgg11": _pt(load_pretrained_vgg, "vgg11"),
     "vgg13": _pt(load_pretrained_vgg, "vgg13"),
     "vgg16": _pt(load_pretrained_vgg, "vgg16"),
@@ -375,6 +405,7 @@ _PRETRAINED_LOADERS = {
     "alexnet_s2d": _pt(load_pretrained_alexnet, space_to_depth=True),
     "resnet18_s2d": _pt(load_pretrained_resnet18, space_to_depth=True),
     "resnet34_s2d": _pt(load_pretrained_resnet34, space_to_depth=True),
+    "resnet50_s2d": _pt(load_pretrained_resnet50, space_to_depth=True),
 }
 
 
