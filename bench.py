@@ -548,18 +548,19 @@ def main():
     bf16_opt = lambda: _optim.Adam(1e-3, state_dtype="bfloat16")
     cnn_configs = [
         # (name, factory, per-chip batch, scan K, timed steps, opt factory)
-        # K=32 on the CNN rows = the product default (loop._AUTO_SCAN_CAP):
-        # the tunnel's per-dispatch RTT varies 5-30 ms across sessions, and
-        # K is the pure-amortization lever against it (BASELINE.md)
+        # K=64 on the CNN rows = the product default (loop._AUTO_SCAN_CAP,
+        # within the staged-chunk budget for these uint8 inputs): the
+        # tunnel's per-dispatch RTT varies ~7-240 ms across sessions, and K
+        # is the pure-amortization lever against it (BASELINE.md)
         ("alexnet f32 224 (per-step dispatch)",
          lambda: (AlexNet(10), make_train_augment(size=224)), 128, 1, 64, None),
         ("alexnet f32 224 (scan-fused)",
-         lambda: (AlexNet(10), make_train_augment(size=224)), 128, 32, 96, None),
-        ("alexnet bf16 224 (scan-fused)", bf16_alexnet, 128, 32, 96, None),
+         lambda: (AlexNet(10), make_train_augment(size=224)), 128, 64, 128, None),
+        ("alexnet bf16 224 (scan-fused)", bf16_alexnet, 128, 64, 128, None),
         # bf16 Adam m/v storage (training.optimizer_state_dtype): halves the
         # optimizer-state HBM traffic that bounds AlexNet at the reference's
         # own b128 (profile-backed; see BASELINE.md "Where the time goes")
-        ("alexnet bf16 224 bf16-opt (scan-fused)", bf16_alexnet, 128, 32, 96,
+        ("alexnet bf16 224 bf16-opt (scan-fused)", bf16_alexnet, 128, 64, 128,
          bf16_opt),
         # exact space-to-depth stem reparameterization (model: alexnet_s2d):
         # the 11x11/s4 3-channel stem becomes a unit-stride conv over 48
@@ -568,22 +569,22 @@ def main():
         ("alexnet bf16 224 bf16-opt s2d (scan-fused)",
          lambda: (AlexNet(10, space_to_depth=True),
                   make_train_augment(size=224, compute_dtype=jnp.bfloat16)),
-         128, 32, 96, bf16_opt),
+         128, 64, 128, bf16_opt),
         # the TPU-right batch: amortizes the remaining fixed per-step
         # param+grad HBM traffic over 4x the samples
-        ("alexnet bf16 224 b512 bf16-opt (scan-fused)", bf16_alexnet, 512, 8,
-         24, bf16_opt),
+        ("alexnet bf16 224 b512 bf16-opt (scan-fused)", bf16_alexnet, 512, 16,
+         32, bf16_opt),
         # the measured sweet spot: with the s2d stem, b256 matches-or-beats
         # the b512 row at half the per-chip batch (same-session artifact
         # pair, BENCH_r04.json: 39.3% vs 38.0%)
         ("alexnet bf16 224 b256 bf16-opt s2d (scan-fused)",
          lambda: (AlexNet(10, space_to_depth=True),
                   make_train_augment(size=224, compute_dtype=jnp.bfloat16)),
-         256, 16, 48, bf16_opt),
+         256, 32, 64, bf16_opt),
         ("resnet18 bf16 32x32 sync-BN (scan-fused)",
-         lambda: cifar_resnet(ResNet18), 128, 32, 96, None),
+         lambda: cifar_resnet(ResNet18), 128, 64, 128, None),
         ("resnet34 bf16 32x32 sync-BN (scan-fused)",
-         lambda: cifar_resnet(ResNet34), 128, 32, 64, None),
+         lambda: cifar_resnet(ResNet34), 128, 64, 128, None),
         # the full-resolution reference-class CNN (data_and_toy_model.py:13-36
         # is 224x224): profile-backed accounting in BASELINE.md "Where the
         # time goes (ResNet-18@224)"; s2d = exact 7x7/s2 stem
@@ -591,11 +592,11 @@ def main():
         ("resnet18 bf16 224 b128 bf16-opt (scan-fused)",
          lambda: (ResNet18(10),
                   make_train_augment(size=224, compute_dtype=jnp.bfloat16)),
-         128, 32, 64, bf16_opt),
+         128, 64, 128, bf16_opt),
         ("resnet18 bf16 224 b128 bf16-opt s2d (scan-fused)",
          lambda: (ResNet18(10, space_to_depth=True),
                   make_train_augment(size=224, compute_dtype=jnp.bfloat16)),
-         128, 32, 64, bf16_opt),
+         128, 64, 128, bf16_opt),
     ]
     for name, make, batch, scan, steps, opt in cnn_configs:
         try:  # diagnostics only — independent, and never break the headline line

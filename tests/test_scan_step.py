@@ -21,8 +21,12 @@ def test_resolve_scan_steps_auto_caps_by_model_size():
     from tpuddp.training.loop import resolve_scan_steps
 
     mb = 1024 * 1024
-    assert resolve_scan_steps("auto", 1000) == 32  # unknown size: conservative
+    assert resolve_scan_steps("auto", 1000) == 32  # unknown batch size: conservative
     assert resolve_scan_steps("auto", 1000, param_bytes=100 * mb) == 32
+    # known batch bytes: deep cap, bounded by the ~256MB staging budget
+    assert resolve_scan_steps("auto", 1000, param_bytes=100 * mb, batch_nbytes=mb) == 64
+    assert resolve_scan_steps("auto", 1000, param_bytes=100 * mb, batch_nbytes=16 * mb) == 16
+    assert resolve_scan_steps("auto", 1000, param_bytes=100 * mb, batch_nbytes=10_000 * mb) == 1
     # dispatch-bound small models get the deep cap (BASELINE.md K-sweep)
     assert resolve_scan_steps("auto", 1000, param_bytes=2 * mb) == 64
     assert resolve_scan_steps("auto", 5, param_bytes=2 * mb) == 5  # epoch-bound

@@ -38,7 +38,8 @@ TRAINING_DEFAULTS = {
     "mode": "shard_map",
     "sync_bn": False,
     "scan_steps": "auto",  # K train steps fused per dispatch (lax.scan);
-    # "auto" = size-resolved: up to 64 for sub-4MB models, 32 otherwise
+    # "auto" = size-resolved: up to 64, capped by a ~256MB staged-chunk
+    # budget (32 when the batch size in bytes is unknowable)
     "clip_grad_norm": None,  # clip the cross-replica-AVERAGED grad (README's
     # clip-before-aggregate caveat: clipping per-shard grads then averaging
     # would differ; tpuddp clips after the pmean, identically on all replicas)

@@ -83,6 +83,16 @@ def _fetch_padded(dataset, indices: np.ndarray, batch_size: int):
     return _pad_batch(x, y, batch_size)
 
 
+def _per_sample_nbytes(dataset):
+    """Input bytes of one sample (x only), when the dataset exposes a
+    contiguous ``.images`` array (the protocol _fetch_padded relies on);
+    None otherwise."""
+    images = getattr(dataset, "images", None)
+    if images is None or not hasattr(images, "itemsize"):
+        return None
+    return int(np.prod(images.shape[1:])) * images.itemsize
+
+
 class DataLoader:
     """Single-stream host loader yielding ``(x, y, w)`` numpy batches.
 
@@ -108,6 +118,13 @@ class DataLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.epoch = 0
+
+    @property
+    def batch_nbytes(self):
+        """Input bytes of one host batch (x only) — the epoch driver caps the
+        auto scan depth by a staging-memory budget with this (loop.py)."""
+        per_sample = _per_sample_nbytes(self.dataset)
+        return None if per_sample is None else self.batch_size * per_sample
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
@@ -241,6 +258,16 @@ class ShardedDataLoader:
             self._order.set_epoch(epoch)
         for s in self.samplers:
             s.set_epoch(epoch)
+
+    @property
+    def batch_nbytes(self):
+        """Input bytes of one process-local host batch (x only, all local
+        replicas) — the epoch driver caps the auto scan depth by a
+        staging-memory budget with this (loop.py)."""
+        per_sample = _per_sample_nbytes(self.dataset)
+        if per_sample is None:
+            return None
+        return self.batch_size * len(self.local_ranks) * per_sample
 
     @property
     def num_samples_per_replica(self) -> int:

@@ -38,31 +38,40 @@ from tpuddp.utils.observability import (
 logger = logging.getLogger("tpuddp")
 
 
-_AUTO_SCAN_CAP = 32  # A/B-measured on AlexNet b128 across sessions: K=32 beat
-# K=16 in every pairing (r4 session: K=16 ~3% over K=8; r5 session with a
-# slow tunnel: 19.6 vs 21.8 ms/step — halving the per-dispatch RTT share is
-# pure amortization with no semantic cost). This is the depth the bench's
-# CNN rows publish — the product default and the bench agree
-_AUTO_SCAN_CAP_SMALL = 64  # dispatch-bound models: see resolve_scan_steps
+_AUTO_SCAN_CAP = 64  # A/B-measured on AlexNet b128 across three r5 tunnel
+# states (RTT ~7, ~23, ~240 ms/dispatch): K=64 beat K=32 in every pairing
+# (bad tunnel: 9.8 vs 13.3-15.2 ms/step) — per-dispatch RTT amortization is
+# pure win with no semantic cost. This is the depth the bench's CNN rows
+# publish — the product default and the bench agree.
+_AUTO_SCAN_FALLBACK_CAP = 32  # when the staged-chunk size cannot be known
+_STAGE_BYTES_BUDGET = 256 * 1024 * 1024  # bound on one staged (K, batch) chunk
 _SMALL_PARAM_BYTES = 4 * 1024 * 1024
 
 
-def resolve_scan_steps(scan_steps, n_batches: int, param_bytes=None) -> int:
+def resolve_scan_steps(
+    scan_steps, n_batches: int, param_bytes=None, batch_nbytes=None
+) -> int:
     """Resolve the per-dispatch fusion factor K.
 
-    ``"auto"`` (the default) fuses up to 32 batches per dispatch when the
+    ``"auto"`` (the default) fuses up to 64 batches per dispatch when the
     epoch has at least that many — the measured per-dispatch runtime latency
     dominates per-step time otherwise (BASELINE.md: ~7x on the toy model
-    through a tunneled TPU). For *small* models (whole parameter set under
-    ~4 MB, when ``param_bytes`` is known) the cap is 64: their step compute
-    is so short that dispatch latency still dominates at K=8, and throughput
-    keeps scaling nearly linearly with K (the bench's toy-MLP K-sweep,
-    BASELINE.md). Any integer pins K explicitly; 1 disables fusion (one
-    dispatch per batch, the reference's cadence)."""
+    through a tunneled TPU; the tunnel's RTT swings 7-240 ms between
+    sessions and K is the amortization lever). The staged ``(K, batch, ...)``
+    super-chunk must stay bounded, so when ``batch_nbytes`` (one host
+    batch's input bytes) is known, K is capped to a ~256 MB staging budget;
+    unknown-size batches on non-small models fall back to a conservative 32.
+    Small models (whole parameter set under ~4 MB) always get 64 — their
+    batches are small by construction and dispatch latency dominates even
+    deeper (the bench's toy-MLP K-sweep). Any integer pins K explicitly; 1
+    disables fusion (one dispatch per batch, the reference's cadence)."""
     if scan_steps in (None, "auto"):
-        cap = _AUTO_SCAN_CAP
-        if param_bytes is not None and param_bytes < _SMALL_PARAM_BYTES:
-            cap = _AUTO_SCAN_CAP_SMALL
+        small = param_bytes is not None and param_bytes < _SMALL_PARAM_BYTES
+        cap = _AUTO_SCAN_CAP if (small or batch_nbytes) else _AUTO_SCAN_FALLBACK_CAP
+        if batch_nbytes:
+            # the staging budget binds regardless of model size — a small
+            # model on large inputs still stages K x batch bytes
+            cap = max(1, min(cap, _STAGE_BYTES_BUDGET // int(batch_nbytes)))
         return max(1, min(cap, n_batches))
     k = int(scan_steps)
     if k < 1:
@@ -160,16 +169,38 @@ def run_training_loop(
     is_main = jax.process_index() == 0
     pbytes = _param_bytes(state.params) if hasattr(state, "params") else None
     eval_scan_steps = (
-        resolve_scan_steps(scan_steps, len(test_loader), pbytes)
+        resolve_scan_steps(
+            scan_steps, len(test_loader), pbytes,
+            getattr(test_loader, "batch_nbytes", None),
+        )
         if hasattr(ddp, "eval_step_many")
         else 1
     )
-    scan_steps = resolve_scan_steps(scan_steps, len(train_loader), pbytes)
+    scan_steps = resolve_scan_steps(
+        scan_steps, len(train_loader), pbytes,
+        getattr(train_loader, "batch_nbytes", None),
+    )
     accum = int(getattr(ddp, "grad_accumulation", 1) or 1)
     if accum > 1:
         # chunks must hold whole accumulation cycles: round K up to the
         # cycle length, then down to a multiple of it
         scan_steps = max(accum, (scan_steps // accum) * accum)
+        bnb = getattr(train_loader, "batch_nbytes", None)
+        if bnb and scan_steps * bnb > _STAGE_BYTES_BUDGET:
+            # respect the staging budget in whole cycles; one cycle is the
+            # floor (the accumulation step needs whole cycles), warn if even
+            # that exceeds the budget
+            scan_steps = max(
+                accum, (_STAGE_BYTES_BUDGET // bnb) // accum * accum
+            )
+            if scan_steps * bnb > _STAGE_BYTES_BUDGET:
+                logger.warning(
+                    "gradient_accumulation_steps=%d forces a staged chunk of "
+                    "%.0f MB (one whole cycle), over the ~%d MB staging "
+                    "budget; reduce the accumulation depth or batch size if "
+                    "the host/device cannot hold it",
+                    accum, scan_steps * bnb / 1e6, _STAGE_BYTES_BUDGET // 2**20,
+                )
     history = []
     metrics_writer = MetricsWriter(save_dir)
     profiling = maybe_start_profiler(save_dir)  # $TPUDDP_PROFILE hook
