@@ -923,3 +923,16 @@ def test_train_mode_forward_masks_padded_rows(mesh):
 
     real_logits = np.asarray(model(x[:6]))  # stats over the same 6 real rows
     np.testing.assert_allclose(padded_logits, real_logits, rtol=1e-4, atol=1e-5)
+
+
+def test_auto_fuse_respects_staging_budget():
+    """The managed auto depth is flat 32 capped by the ~256MB queued-batch
+    staging budget (same bound as the native scan_steps auto), so a
+    large-input model cannot queue gigabytes of device batches by default."""
+    from tpuddp.accelerate import _resolve_auto_fuse
+
+    assert _resolve_auto_fuse(None) == 32
+    # 128 x 224x224x3 bf16 batches: 38.5MB each -> cap 6
+    assert _resolve_auto_fuse(None, batch_nbytes=38_535_168) == 6
+    assert _resolve_auto_fuse(None, batch_nbytes=400_000) == 32
+    assert _resolve_auto_fuse(None, batch_nbytes=10**10) == 1

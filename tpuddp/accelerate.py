@@ -240,8 +240,9 @@ class FusedEvaluator:
         self.model = model
         self.criterion = criterion
         self.transform = transform
-        # None = size-resolved at first use (32 for sub-4MB dispatch-bound
-        # models, 8 otherwise — the same policy as the train-side fuse auto)
+        # None = resolved at first use (flat 32, capped by the staging
+        # budget over the batch bytes — the same policy as the train-side
+        # fuse auto; see _resolve_auto_fuse)
         self.fuse_steps = None if fuse_steps is None else max(1, int(fuse_steps))
         self._queue = []
         self._stats = None
@@ -250,10 +251,18 @@ class FusedEvaluator:
     def _resolve_fuse(self) -> int:
         if self.fuse_steps is not None:
             return self.fuse_steps
+        batch_nbytes = None
+        if self._queue:
+            # .nbytes is metadata on both numpy and jax arrays — never
+            # np.asarray a queued x here, it may be a staged device array
+            # and the conversion would force a host transfer
+            batch_nbytes = getattr(self._queue[0][1], "nbytes", None)
         params = self.model._params
         if params is None or params is _LOST_TO_FAILED_FLUSH:
-            return 8  # tentative; cache only once the real size is known
-        self.fuse_steps = _resolve_auto_fuse(params)
+            # don't cache while the model is unresolved; still honor the
+            # flat-32-under-budget policy for this call
+            return _resolve_auto_fuse(None, batch_nbytes)
+        self.fuse_steps = _resolve_auto_fuse(params, batch_nbytes)
         return self.fuse_steps
 
     def add(self, x, y, w=None):
@@ -423,14 +432,28 @@ class _FlatShardedUpdate(optim_lib.Optimizer):
         return _vec_to_tree(new_p_vec, self.spec), new_os
 
 
-def _resolve_auto_fuse(params) -> int:
-    """The managed size-aware fusion depth: 32 for dispatch-bound small
-    models (whole parameter set under ~4 MB), 8 otherwise — the
-    BASELINE-measured policy, shared by the train-side fuse_steps="auto"
-    and the FusedEvaluator so the two can't drift apart."""
-    from tpuddp.training.loop import _SMALL_PARAM_BYTES, _param_bytes
+def _resolve_auto_fuse(params, batch_nbytes=None) -> int:
+    """The managed auto fusion depth: 32, capped by the SAME ~256 MB
+    staged-bytes budget as the native ``scan_steps: auto``
+    (training/loop.py) when the per-batch input bytes are known — the queue
+    holds K device batches before each flush, so depth × batch bytes is
+    real HBM. Shared by the train-side fuse_steps="auto" and the
+    FusedEvaluator so the two can't drift apart.
 
-    return 32 if _param_bytes(params) < _SMALL_PARAM_BYTES else 8
+    Big models used a shallower flat 8 through r4 (per-batch sharded
+    placement flattens the scaling), but the r5 full-bench managed-AlexNet
+    row measured fuse=32 within 2.9% of the native K-fused step, and the
+    tunnel's per-dispatch RTT swings up to ~240 ms between sessions — depth
+    is the amortization lever (BASELINE.md "Dispatch-RTT variance").
+    ``params`` stays in the signature as the size hook should the policy
+    become size-keyed again."""
+    del params
+    cap = 32
+    if batch_nbytes:
+        from tpuddp.training.loop import _STAGE_BYTES_BUDGET
+
+        cap = max(1, min(cap, _STAGE_BYTES_BUDGET // int(batch_nbytes)))
+    return cap
 
 
 class _LostState:
@@ -821,14 +844,13 @@ class PreparedOptimizer:
             if fuse is None:
                 fuse = getattr(model.accelerator, "fuse_steps", 1)
                 if fuse == "auto":
-                    # size-aware resolution, once per optimizer, now that
-                    # params exist: small (dispatch-bound) models fuse
-                    # deeper. Same SHAPE of policy as the native
-                    # resolve_scan_steps (size-keyed depth), different
-                    # constant — each managed step still pays per-batch
-                    # sharded placement, so its scaling flattens earlier
-                    # than the native scan's 64.
-                    fuse = _resolve_auto_fuse(model._params)
+                    # resolved once per optimizer, at the first step, when a
+                    # real batch is in hand: flat 32 capped by the staging
+                    # budget over THIS batch's bytes (the queue holds K such
+                    # batches on device before each flush)
+                    fuse = _resolve_auto_fuse(
+                        model._params, getattr(xb, "nbytes", None)
+                    )
                 self._fuse = fuse
             if fuse > 1:
                 # queue the sharded step; K of them run as ONE scan dispatch.
@@ -1030,12 +1052,12 @@ class Accelerator:
         lax.scan dispatch (the managed analog of the native scan fusion) —
         loss values then materialize at flush time, so pair it with deferred
         metric reading (collect the LazyLoss objects; read at epoch end).
-        ``"auto"`` resolves at each optimizer's first step from its model's
-        size: 32 for dispatch-bound small models (whole parameter set under
-        ~4 MB — the BASELINE-measured managed sweet spot), 8 otherwise. Same
-        size-keyed SHAPE as the native ``scan_steps: auto`` policy; the
-        constants differ (native small cap is 64) because each managed step
-        still pays per-batch sharded placement.
+        ``"auto"`` resolves at each optimizer's first step to 32 (the
+        BASELINE-measured managed depth — the r5 full-bench managed-AlexNet
+        row ran fuse=32 within ~3% of the native K-fused step). The native
+        ``scan_steps: auto`` analog goes deeper (64) because the native scan
+        stages one super-batch instead of paying per-batch sharded
+        placement.
 
         ``num_chips``: restrict the data mesh to the first N local devices
         (the managed analog of ``local.tpu.num_chips`` — without it a

@@ -50,7 +50,7 @@ TRAINING_DEFAULTS = {
     "prefetch": True,  # background-thread host batch prefetch
     "deferred_metrics": False,  # managed path: epoch-end (not per-batch) metric sync
     "fuse_steps": "auto",  # managed path: K step()s per dispatch (auto, with
-    # deferred_metrics: size-resolved — 32 for sub-4MB models, 8 otherwise)
+    # deferred_metrics: 32, capped by a ~256MB queued-batch staging budget)
     "gradient_accumulation_steps": 1,  # one averaged update every N micro-batches (both paths)
     "optimizer_state_dtype": None,  # Adam m/v storage dtype ("bfloat16" halves
     # optimizer HBM traffic; math stays f32). None -> params' dtype.
