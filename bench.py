@@ -425,7 +425,9 @@ def bench_managed_eval(batch_per_chip=128, batches=256, fused=True, fuse_k=None)
 
     if fused:
         ev = FusedEvaluator(model, criterion, transform=transform, fuse_steps=fuse_k)
-        fuse_k = ev._resolve_fuse()  # the size-resolved product default
+        # the product default (flat 32; toy batches are far under the
+        # staging budget so the probe matches the in-run resolution)
+        fuse_k = ev._resolve_fuse()
 
         def run(n):
             for _ in range(n):

@@ -519,3 +519,29 @@ def test_resnet50_import_rejects_resnet18_checkpoint(tmp_path):
     params, mstate = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
     with pytest.raises((ValueError, KeyError)):
         convert_resnet_bottleneck_state_dict(donor18.state_dict(), params, mstate)
+
+
+@pytest.mark.slow
+def test_imported_resnet101_reproduces_torch_logits():
+    """ResNet-101 ([3,4,23,3] Bottleneck) through the same converter."""
+    from tpuddp.models import ResNet101
+    from tpuddp.models.torch_import import convert_resnet_bottleneck_state_dict
+    from tpuddp.nn.core import Context
+
+    torch.manual_seed(21)
+    donor = _TorchResNet50(num_classes=100, depths=(3, 4, 23, 3))
+    donor.train()
+    with torch.no_grad():
+        donor(torch.randn(2, 3, 64, 64))
+    donor.eval()
+
+    model = ResNet101(num_classes=100)
+    params, mstate = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)))
+    params, mstate = convert_resnet_bottleneck_state_dict(
+        donor.state_dict(), params, mstate, depths=(3, 4, 23, 3)
+    )
+    x = np.random.RandomState(4).randn(2, 64, 64, 3).astype(np.float32)
+    ours, _ = model.apply(params, mstate, jnp.asarray(x), Context(train=False))
+    with torch.no_grad():
+        ref = donor(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-3, atol=5e-4)

@@ -258,9 +258,10 @@ class FusedEvaluator:
             # and the conversion would force a host transfer
             batch_nbytes = getattr(self._queue[0][1], "nbytes", None)
         params = self.model._params
-        if params is None or params is _LOST_TO_FAILED_FLUSH:
-            # don't cache while the model is unresolved; still honor the
-            # flat-32-under-budget policy for this call
+        if params is None or params is _LOST_TO_FAILED_FLUSH or not self._queue:
+            # don't cache while the model is unresolved OR before a real
+            # batch is in hand (an empty-queue probe would pin the uncapped
+            # depth and bypass the staging budget for the evaluator's life)
             return _resolve_auto_fuse(None, batch_nbytes)
         self.fuse_steps = _resolve_auto_fuse(params, batch_nbytes)
         return self.fuse_steps
@@ -1054,10 +1055,12 @@ class Accelerator:
         metric reading (collect the LazyLoss objects; read at epoch end).
         ``"auto"`` resolves at each optimizer's first step to 32 (the
         BASELINE-measured managed depth — the r5 full-bench managed-AlexNet
-        row ran fuse=32 within ~3% of the native K-fused step). The native
-        ``scan_steps: auto`` analog goes deeper (64) because the native scan
-        stages one super-batch instead of paying per-batch sharded
-        placement.
+        row ran fuse=32 within ~3% of the native K-fused step), capped by a
+        ~256 MB queued-batch staging budget computed from the actual batch's
+        bytes (large inputs resolve shallower; e.g. 128x224x224x3 bf16
+        batches cap at 6). The native ``scan_steps: auto`` analog goes
+        deeper (64, same budget) because the native scan stages one
+        super-batch instead of paying per-batch sharded placement.
 
         ``num_chips``: restrict the data mesh to the first N local devices
         (the managed analog of ``local.tpu.num_chips`` — without it a

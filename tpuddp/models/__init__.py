@@ -1,12 +1,14 @@
 """Model zoo. The reference's zoo is ``load_model`` = pretrained AlexNet with
 its classifier head swapped for CIFAR-10 (data_and_toy_model.py:41-45); tpuddp
 adds genuinely small toy models for fast CI (per SURVEY.md scale calibration),
-ResNet-18/34 (BasicBlock) + ResNet-50 (Bottleneck), VGG-11/13/16, and
+ResNet-18/34 (BasicBlock) + ResNet-50/101/152 (Bottleneck), VGG-11/13/16, and
 CIFAR-stem/space-to-depth variants; all torch-importable."""
 
 from tpuddp.models.toy import ToyCNN, ToyMLP  # noqa: F401
 from tpuddp.models.alexnet import AlexNet  # noqa: F401
-from tpuddp.models.resnet import ResNet18, ResNet34, ResNet50  # noqa: F401
+from tpuddp.models.resnet import (  # noqa: F401
+    ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
+)
 from tpuddp.models.vgg import VGG11, VGG13, VGG16  # noqa: F401
 
 from functools import partial as _partial
@@ -18,6 +20,8 @@ _REGISTRY = {
     "resnet18": ResNet18,
     "resnet34": ResNet34,
     "resnet50": ResNet50,
+    "resnet101": ResNet101,
+    "resnet152": ResNet152,
     "vgg11": VGG11,
     "vgg13": VGG13,
     "vgg16": VGG16,
@@ -25,12 +29,16 @@ _REGISTRY = {
     "resnet18_small": _partial(ResNet18, small_input=True),
     "resnet34_small": _partial(ResNet34, small_input=True),
     "resnet50_small": _partial(ResNet50, small_input=True),
+    "resnet101_small": _partial(ResNet101, small_input=True),
+    "resnet152_small": _partial(ResNet152, small_input=True),
     # exact space-to-depth stem reparameterization (same params/checkpoints;
     # faster MXU mapping for the thin-channel strided stems)
     "alexnet_s2d": _partial(AlexNet, space_to_depth=True),
     "resnet18_s2d": _partial(ResNet18, space_to_depth=True),
     "resnet34_s2d": _partial(ResNet34, space_to_depth=True),
     "resnet50_s2d": _partial(ResNet50, space_to_depth=True),
+    "resnet101_s2d": _partial(ResNet101, space_to_depth=True),
+    "resnet152_s2d": _partial(ResNet152, space_to_depth=True),
 }
 
 
@@ -45,6 +53,7 @@ def load_model(name: str = "alexnet", num_classes: int = 10, **kwargs):
 
 __all__ = [
     "ToyMLP", "ToyCNN", "AlexNet", "ResNet18", "ResNet34", "ResNet50",
+    "ResNet101", "ResNet152",
     "VGG11", "VGG13", "VGG16",
     "load_model",
 ]

@@ -1,5 +1,5 @@
 """ResNets (NHWC) — ResNet-18/34 (BasicBlock; -18 is the multi-host CIFAR
-BASELINE config, BASELINE.json configs[4]) and ResNet-50 (Bottleneck).
+BASELINE config, BASELINE.json configs[4]) and ResNet-50/101/152 (Bottleneck).
 BatchNorm layers honor convert_sync_batchnorm / ``sync_bn=True`` so
 cross-replica statistic sync works under DP."""
 
@@ -202,5 +202,27 @@ def ResNet50(
     stride placement: the 3x3 conv strides)."""
     return _resnet(
         (3, 4, 6, 3), num_classes, sync_bn, small_input, space_to_depth,
+        block=Bottleneck,
+    )
+
+
+def ResNet101(
+    num_classes: int = 10, sync_bn: bool = False, small_input: bool = False,
+    space_to_depth: bool = False,
+) -> nn.Sequential:
+    """Standard ResNet-101: [3,4,23,3] Bottleneck blocks."""
+    return _resnet(
+        (3, 4, 23, 3), num_classes, sync_bn, small_input, space_to_depth,
+        block=Bottleneck,
+    )
+
+
+def ResNet152(
+    num_classes: int = 10, sync_bn: bool = False, small_input: bool = False,
+    space_to_depth: bool = False,
+) -> nn.Sequential:
+    """Standard ResNet-152: [3,8,36,3] Bottleneck blocks."""
+    return _resnet(
+        (3, 8, 36, 3), num_classes, sync_bn, small_input, space_to_depth,
         block=Bottleneck,
     )

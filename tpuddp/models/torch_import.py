@@ -1,5 +1,5 @@
 """Import torch/torchvision checkpoints into tpuddp models (AlexNet,
-VGG-11/13/16, ResNet-18/34/50).
+VGG-11/13/16, ResNet-18/34/50/101/152).
 
 The reference starts from *pretrained* torchvision AlexNet weights
 (data_and_toy_model.py:41-43). This build runs zero-egress, so pretrained
@@ -304,7 +304,8 @@ def convert_resnet_basic_state_dict(
 def convert_resnet_bottleneck_state_dict(
     state_dict: Mapping[str, object], params, model_state, depths=(3, 4, 6, 3)
 ):
-    """Bottleneck-family converter — (3,4,6,3) is ResNet-50."""
+    """Bottleneck-family converter — (3,4,6,3) is ResNet-50, (3,4,23,3)
+    ResNet-101, (3,8,36,3) ResNet-152."""
     return _convert_resnet_state_dict(state_dict, params, model_state, depths, 3)
 
 
@@ -358,20 +359,40 @@ def load_pretrained_resnet34(
     )
 
 
-def load_pretrained_resnet50(
-    path: str, key, num_classes: int = 10, image_size: int = 224,
-    space_to_depth: bool = False,
-):
-    """ResNet-50 analog — [3,4,6,3] Bottleneck blocks (2048-wide head)."""
-    from tpuddp.models.resnet import ResNet50
+def _load_pretrained_bottleneck(name, cls_name, depths, salt):
+    """Build the fine-tune loader for one Bottleneck family member (the
+    ResNet-50/101/152 analog of :func:`load_pretrained_resnet18`)."""
 
-    return _load_pretrained(
-        path, key, num_classes, image_size,
-        build=lambda n: ResNet50(num_classes=n, space_to_depth=space_to_depth),
-        head_weight_key="fc.weight",
-        convert=convert_resnet_bottleneck_state_dict,
-        salt=0x9eb,
+    def loader(path, key, num_classes=10, image_size=224, space_to_depth=False):
+        from tpuddp.models import resnet as resnet_lib
+
+        cls = getattr(resnet_lib, cls_name)
+        return _load_pretrained(
+            path, key, num_classes, image_size,
+            build=lambda n: cls(num_classes=n, space_to_depth=space_to_depth),
+            head_weight_key="fc.weight",
+            convert=_pt(convert_resnet_bottleneck_state_dict, depths=depths),
+            salt=salt,
+        )
+
+    loader.__name__ = loader.__qualname__ = f"load_pretrained_{name}"
+    loader.__doc__ = (
+        f"{cls_name} fine-tune loader — {list(depths)} Bottleneck blocks "
+        "(2048-wide head); torchvision-layout checkpoints, head swapped to "
+        "``num_classes`` when the widths differ."
     )
+    return loader
+
+
+load_pretrained_resnet50 = _load_pretrained_bottleneck(
+    "resnet50", "ResNet50", (3, 4, 6, 3), 0x9eb
+)
+load_pretrained_resnet101 = _load_pretrained_bottleneck(
+    "resnet101", "ResNet101", (3, 4, 23, 3), 0x9ec
+)
+load_pretrained_resnet152 = _load_pretrained_bottleneck(
+    "resnet152", "ResNet152", (3, 8, 36, 3), 0x9ed
+)
 
 
 def load_pretrained_vgg(
@@ -397,6 +418,8 @@ _PRETRAINED_LOADERS = {
     "resnet18": load_pretrained_resnet18,
     "resnet34": load_pretrained_resnet34,
     "resnet50": load_pretrained_resnet50,
+    "resnet101": load_pretrained_resnet101,
+    "resnet152": load_pretrained_resnet152,
     "vgg11": _pt(load_pretrained_vgg, "vgg11"),
     "vgg13": _pt(load_pretrained_vgg, "vgg13"),
     "vgg16": _pt(load_pretrained_vgg, "vgg16"),
@@ -406,6 +429,8 @@ _PRETRAINED_LOADERS = {
     "resnet18_s2d": _pt(load_pretrained_resnet18, space_to_depth=True),
     "resnet34_s2d": _pt(load_pretrained_resnet34, space_to_depth=True),
     "resnet50_s2d": _pt(load_pretrained_resnet50, space_to_depth=True),
+    "resnet101_s2d": _pt(load_pretrained_resnet101, space_to_depth=True),
+    "resnet152_s2d": _pt(load_pretrained_resnet152, space_to_depth=True),
 }
 
 
