@@ -87,6 +87,53 @@ def test_accum_cycle_equals_concatenated_batch(cpu_devices, mode, wus):
     assert float(np.sum(np.asarray(acc_m["n"]))) == 64.0
 
 
+def test_accum_exact_for_fractional_sample_weights(cpu_devices):
+    """The cycle divisor is the exact weight sum (jnp.where, not
+    jnp.maximum): fractional per-sample weights — importance weighting, not
+    just 0/1 padding masks — must still reproduce the concatenated batch."""
+    mesh = make_mesh(cpu_devices)
+    rng = np.random.RandomState(9)
+    batches = []
+    for i in range(2):
+        x = rng.randn(16, 8, 8, 3).astype(np.float32)
+        y = rng.randint(0, 10, 16)
+        w = rng.uniform(0.05, 0.6, 16).astype(np.float32)  # sums < 16
+        batches.append((x, y, w))
+    model = ToyMLP()
+
+    a_ddp = DistributedDataParallel(
+        model, optim.SGD(1e-1), CrossEntropyLoss(), mesh=mesh,
+        grad_accumulation=2,
+    )
+    a_state = a_ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    a_state, _ = a_ddp.train_step_many(
+        a_state, a_ddp.shard_stacked(stack_batches(batches))
+    )
+
+    b_ddp = DistributedDataParallel(
+        model, optim.SGD(1e-1), CrossEntropyLoss(), mesh=mesh
+    )
+    b_state = b_ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    # The equivalence oracle must hand each replica the SAME samples the
+    # accumulation path gives it: replica r sees rows [2r:2r+2] of every
+    # micro-batch, so the concatenated batch is interleaved per replica
+    # (with non-uniform weights, pmean of per-replica weighted means is NOT
+    # invariant to the replica-to-sample assignment — a DDP semantic torch
+    # shares, not an accumulation artifact).
+    per_replica = 16 // 8
+
+    def interleave(i):
+        return np.concatenate([
+            np.concatenate([b[i][r * per_replica : (r + 1) * per_replica] for b in batches])
+            for r in range(8)
+        ])
+
+    cat = (interleave(0), interleave(1), interleave(2))
+    b_state, _ = b_ddp.train_step(b_state, b_ddp.shard(cat))
+
+    _leaves_allclose(a_state.params, b_state.params, atol=1e-5)
+
+
 def test_accum_trajectory_multiple_cycles_adam(cpu_devices):
     """2 cycles of A=2 (scan K=4) track 2 plain Adam steps at doubled batch.
     ToyMLP: BatchNorm models are deliberately excluded — normalizing each
