@@ -356,7 +356,7 @@ def _torch_vgg11(num_classes=1000):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("name", ["vgg11", "vgg13", "vgg16"])
+@pytest.mark.parametrize("name", ["vgg11", "vgg13", "vgg16", "vgg19"])
 def test_imported_vgg_reproduces_torch_logits(name):
     from tpuddp.models import load_model
     from tpuddp.models.torch_import import convert_vgg_state_dict

@@ -1,7 +1,7 @@
 """Model zoo. The reference's zoo is ``load_model`` = pretrained AlexNet with
 its classifier head swapped for CIFAR-10 (data_and_toy_model.py:41-45); tpuddp
 adds genuinely small toy models for fast CI (per SURVEY.md scale calibration),
-ResNet-18/34 (BasicBlock) + ResNet-50/101/152 (Bottleneck), VGG-11/13/16, and
+ResNet-18/34 (BasicBlock) + ResNet-50/101/152 (Bottleneck), VGG-11/13/16/19, and
 CIFAR-stem/space-to-depth variants; all torch-importable."""
 
 from tpuddp.models.toy import ToyCNN, ToyMLP  # noqa: F401
@@ -9,7 +9,7 @@ from tpuddp.models.alexnet import AlexNet  # noqa: F401
 from tpuddp.models.resnet import (  # noqa: F401
     ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
 )
-from tpuddp.models.vgg import VGG11, VGG13, VGG16  # noqa: F401
+from tpuddp.models.vgg import VGG11, VGG13, VGG16, VGG19  # noqa: F401
 
 from functools import partial as _partial
 
@@ -25,6 +25,7 @@ _REGISTRY = {
     "vgg11": VGG11,
     "vgg13": VGG13,
     "vgg16": VGG16,
+    "vgg19": VGG19,
     # CIFAR-style stem (3x3 conv, no maxpool) for small native resolutions
     "resnet18_small": _partial(ResNet18, small_input=True),
     "resnet34_small": _partial(ResNet34, small_input=True),
@@ -54,6 +55,6 @@ def load_model(name: str = "alexnet", num_classes: int = 10, **kwargs):
 __all__ = [
     "ToyMLP", "ToyCNN", "AlexNet", "ResNet18", "ResNet34", "ResNet50",
     "ResNet101", "ResNet152",
-    "VGG11", "VGG13", "VGG16",
+    "VGG11", "VGG13", "VGG16", "VGG19",
     "load_model",
 ]

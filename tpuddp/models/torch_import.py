@@ -1,5 +1,5 @@
 """Import torch/torchvision checkpoints into tpuddp models (AlexNet,
-VGG-11/13/16, ResNet-18/34/50/101/152).
+VGG-11/13/16/19, ResNet-18/34/50/101/152).
 
 The reference starts from *pretrained* torchvision AlexNet weights
 (data_and_toy_model.py:41-43). This build runs zero-egress, so pretrained
@@ -403,7 +403,10 @@ def load_pretrained_vgg(
     head when the widths differ."""
     from tpuddp.models import vgg as vgg_lib
 
-    build_cls = {"vgg11": vgg_lib.VGG11, "vgg13": vgg_lib.VGG13, "vgg16": vgg_lib.VGG16}[name]
+    build_cls = {
+        "vgg11": vgg_lib.VGG11, "vgg13": vgg_lib.VGG13,
+        "vgg16": vgg_lib.VGG16, "vgg19": vgg_lib.VGG19,
+    }[name]
     return _load_pretrained(
         path, key, num_classes, image_size,
         build=lambda n: build_cls(num_classes=n),
@@ -423,6 +426,7 @@ _PRETRAINED_LOADERS = {
     "vgg11": _pt(load_pretrained_vgg, "vgg11"),
     "vgg13": _pt(load_pretrained_vgg, "vgg13"),
     "vgg16": _pt(load_pretrained_vgg, "vgg16"),
+    "vgg19": _pt(load_pretrained_vgg, "vgg19"),
     # s2d stems share the exact parameter layout, so the same torch
     # checkpoints load into them (the "_s2d = same checkpoints" promise)
     "alexnet_s2d": _pt(load_pretrained_alexnet, space_to_depth=True),

@@ -1,4 +1,4 @@
-"""VGG family (configurations A/B/D = VGG-11/13/16) — NHWC,
+"""VGG family (configurations A/B/D/E = VGG-11/13/16/19) — NHWC,
 torchvision-layout-compatible.
 
 Extends the zoo beyond the reference's AlexNet (data_and_toy_model.py:41-45)
@@ -19,6 +19,8 @@ VGG_PLANS = {
     "vgg13": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
     "vgg16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
               512, 512, 512, "M"],
+    "vgg19": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+              512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
 }
 
 
@@ -81,3 +83,8 @@ def VGG13(num_classes: int = 10, dropout: float = 0.5) -> nn.Sequential:
 def VGG16(num_classes: int = 10, dropout: float = 0.5) -> nn.Sequential:
     """torchvision vgg16 ('D'): 13 convs."""
     return _vgg("vgg16", num_classes, dropout)
+
+
+def VGG19(num_classes: int = 10, dropout: float = 0.5) -> nn.Sequential:
+    """torchvision vgg19 ('E'): 16 convs."""
+    return _vgg("vgg19", num_classes, dropout)
