@@ -1,0 +1,45 @@
+"""Dead-peer worker pair for the watchdog chaos test (test_chaos.py).
+
+Two roles sharing one heartbeat directory (the pod's shared-filesystem
+rendezvous), driven as separate OS processes:
+
+- role 0 — the healthy survivor: arms the heartbeat + watchdog pair via the
+  same ``watchdog.start`` wiring ``spawn.run_ddp_training`` uses, then idles
+  like a process wedged in a collective would. Its watchdog must detect the
+  peer's stale heartbeat and ``os._exit(76)`` — the test asserts that exit.
+- role 1 — the dead peer: heartbeats normally until ``$TPUDDP_FAULT=
+  hang@barrier`` fires on barrier entry, which stops its beat and sleeps
+  forever (indistinguishable from a preempted/OOM-killed host).
+
+Usage: python _chaos_hang_worker.py <process_id> <num_processes> <shared_dir>
+(``$TPUDDP_WATCHDOG_TIMEOUT`` must be set; role 1 also needs $TPUDDP_FAULT.)
+"""
+
+import sys
+import time
+
+pid, nprocs, shared = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+from tpuddp.resilience import watchdog  # noqa: E402
+
+guard = watchdog.start(shared, pid, nprocs, interval=0.25)
+assert guard is not None, "watchdog not armed — $TPUDDP_WATCHDOG_TIMEOUT unset?"
+print(f"WORKER {pid} armed", flush=True)
+
+if pid == 1:
+    # wait for the peer's first beat so the test measures stale-detection
+    # latency, not startup grace
+    hb_dir = watchdog.heartbeat_dir(shared)
+    deadline = time.time() + 60.0
+    while watchdog.read_heartbeat(hb_dir, 0) is None:
+        assert time.time() < deadline, "peer 0 never started heartbeating"
+        time.sleep(0.05)
+
+    from tpuddp.parallel.collectives import barrier  # noqa: E402
+
+    barrier("chaos_rendezvous")  # hang@barrier fires here and never returns
+    print("UNREACHABLE: hang fault did not fire", flush=True)
+    sys.exit(1)
+
+while True:  # healthy role: only the watchdog's exit(76) ends this process
+    time.sleep(0.25)

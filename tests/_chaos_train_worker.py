@@ -1,0 +1,40 @@
+"""Training worker for the chaos suite (launched by test_chaos.py).
+
+Runs a small native DP training job (toy MLP, synthetic-fallback data, 4
+virtual CPU devices) through the full spawn path so the resilience wiring is
+live: SIGTERM drain handlers installed, ``TrainingPreempted`` -> exit 75,
+``$TPUDDP_FAULT`` injection hooks armed, ``$TPUDDP_AUTO_RESUME`` resume.
+
+Usage: python _chaos_train_worker.py <out_dir> <num_epochs>
+"""
+
+import sys
+from functools import partial
+
+out_dir, num_epochs = sys.argv[1], int(sys.argv[2])
+
+from tpuddp.parallel.spawn import run_ddp_training  # noqa: E402
+from train_native import basic_ddp_training_loop  # noqa: E402
+
+TRAINING = {
+    "model": "toy_mlp",
+    "dataset": "cifar10",
+    "data_root": "/nonexistent",  # forces the zero-egress synthetic fallback
+    "train_batch_size": 8,  # per replica: 32-sample global batches
+    "test_batch_size": 8,
+    "learning_rate": 0.01,
+    "num_epochs": num_epochs,
+    "checkpoint_epoch": 1,
+    "image_size": None,
+    "seed": 0,
+    "mode": "shard_map",
+    "synthetic_n": (256, 64),  # 8 train batch groups per epoch
+}
+
+run_ddp_training(
+    partial(basic_ddp_training_loop, training=TRAINING),
+    world_size=4,
+    save_dir=out_dir,
+    optional_args={"set_epoch": True, "print_rand": False},
+    backend="cpu",
+)

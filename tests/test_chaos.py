@@ -1,0 +1,183 @@
+"""Chaos suite (ISSUE 1 acceptance): real subprocess kills and injected
+faults against the resilience layer.
+
+Scenarios: an external SIGTERM mid-training drains into a valid emergency
+checkpoint and exit 75, auto-resume continues exactly where it left off; a
+corrupted newest checkpoint is skipped in favor of the previous good one; an
+injected ``hang@barrier`` dead peer is detected by the heartbeat watchdog
+within the configured timeout (exit 76) instead of hanging forever.
+
+Marked ``chaos`` + ``slow``: run with ``tools/run_chaos.py`` or
+``pytest -m chaos``; never part of the tier-1 fast path.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpuddp.resilience import integrity
+from tpuddp.resilience.preemption import (
+    EXIT_INJECTED_CRASH,
+    EXIT_PREEMPTED,
+    EXIT_WATCHDOG,
+)
+from tpuddp.training import checkpoint as ckpt
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIN_WORKER = os.path.join(REPO, "tests", "_chaos_train_worker.py")
+HANG_WORKER = os.path.join(REPO, "tests", "_chaos_hang_worker.py")
+
+
+def chaos_env(**extra):
+    env = dict(os.environ)
+    # clean CPU-only children: no TPU plugin, no inherited fault/resume flags
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    for k in ("TPUDDP_FAULT", "TPUDDP_AUTO_RESUME", "TPUDDP_WATCHDOG_TIMEOUT"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["TPUDDP_BACKEND"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def run_train_worker(out_dir, epochs, env, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-u", TRAIN_WORKER, str(out_dir), str(epochs)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def history_epochs(out_dir):
+    with open(os.path.join(str(out_dir), "history.jsonl")) as f:
+        return [json.loads(line)["epoch"] for line in f]
+
+
+def test_sigterm_drain_then_auto_resume_round_trip(tmp_path):
+    """The headline scenario: a scheduler SIGTERMs the run mid-training; it
+    drains into an intact emergency checkpoint and exits 75; the requeued
+    command (same argv + $TPUDDP_AUTO_RESUME=1) continues from the recorded
+    epoch with no epoch skipped and none lost."""
+    epochs = 30
+    proc = subprocess.Popen(
+        [sys.executable, "-u", TRAIN_WORKER, str(tmp_path), str(epochs)],
+        env=chaos_env(), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    killed = False
+    deadline = time.time() + 240
+    lines = []
+    for line in proc.stdout:  # epoch banners stream as training progresses
+        lines.append(line)
+        if not killed and ", Epoch 1" in line:
+            proc.send_signal(signal.SIGTERM)
+            killed = True
+        assert time.time() < deadline, "worker did not finish draining in time"
+    rc = proc.wait(timeout=60)
+    out = "".join(lines)
+    assert killed, f"never saw the epoch-1 banner:\n{out[-2000:]}"
+    assert rc == EXIT_PREEMPTED, f"exit {rc} != {EXIT_PREEMPTED}:\n{out[-2000:]}"
+    assert "emergency checkpoint" in out
+
+    # the emergency save is the newest checkpoint, intact, and marked as a
+    # mid-epoch drain (completed=0 -> resume redoes that epoch)
+    found = ckpt.latest(str(tmp_path))
+    assert found is not None
+    path, interrupted_epoch = found
+    assert integrity.verify_file(path)
+    assert ckpt.read_meta(path)["completed"] == 0
+
+    resumed = run_train_worker(tmp_path, epochs=6, env=chaos_env(TPUDDP_AUTO_RESUME=1))
+    assert resumed.returncode == 0, resumed.stdout[-2000:] + resumed.stderr[-2000:]
+    assert f"Auto-resume: continuing from epoch {interrupted_epoch}." in resumed.stdout
+    assert "Finished Training" in resumed.stdout
+    # exact continuation: run 1 logged epochs [0..k), run 2 logged [k..6) —
+    # appended history covers every epoch exactly once, in order
+    assert history_epochs(tmp_path) == list(range(6))
+
+
+def test_injected_preempt_is_deterministic(tmp_path):
+    """preempt@epoch=1 SIGTERMs the process from inside at a known point: the
+    drain must land the emergency checkpoint at exactly epoch 1."""
+    first = run_train_worker(
+        tmp_path, epochs=4, env=chaos_env(TPUDDP_FAULT="preempt@epoch=1")
+    )
+    assert first.returncode == EXIT_PREEMPTED, (
+        first.stdout[-2000:] + first.stderr[-2000:]
+    )
+    emergency = os.path.join(str(tmp_path), "ckpt_1.npz")
+    assert integrity.verify_file(emergency)
+    assert ckpt.read_meta(emergency) == {"epoch": 1, "completed": 0}
+
+    resumed = run_train_worker(tmp_path, epochs=4, env=chaos_env(TPUDDP_AUTO_RESUME=1))
+    assert resumed.returncode == 0, resumed.stdout[-2000:] + resumed.stderr[-2000:]
+    assert "Auto-resume: continuing from epoch 1." in resumed.stdout
+    assert history_epochs(tmp_path) == [0, 1, 2, 3]
+
+
+def test_corrupt_newest_checkpoint_falls_back_on_resume(tmp_path):
+    """corrupt@ckpt_1 garbles the epoch-1 checkpoint after publish, then
+    crash@epoch=2 kills the run uncleanly (exit 113). The resumed run must
+    skip the corrupt newest file with a logged warning and continue from the
+    previous good epoch — redoing epoch 1 rather than crashing or trusting
+    torn bytes."""
+    first = run_train_worker(
+        tmp_path, epochs=4,
+        env=chaos_env(TPUDDP_FAULT="corrupt@ckpt_1,crash@epoch=2"),
+    )
+    assert first.returncode == EXIT_INJECTED_CRASH, (
+        first.stdout[-2000:] + first.stderr[-2000:]
+    )
+    assert integrity.verify_file(os.path.join(str(tmp_path), "ckpt_0.npz"))
+    assert not integrity.verify_file(os.path.join(str(tmp_path), "ckpt_1.npz"))
+
+    resumed = run_train_worker(tmp_path, epochs=4, env=chaos_env(TPUDDP_AUTO_RESUME=1))
+    assert resumed.returncode == 0, resumed.stdout[-2000:] + resumed.stderr[-2000:]
+    both = resumed.stdout + resumed.stderr
+    assert "failed integrity verification" in both
+    assert "Auto-resume: continuing from epoch 1." in resumed.stdout
+    # epoch 1 ran twice: its first checkpoint was corrupted, so the resumed
+    # run redid it from the epoch-0 state
+    assert history_epochs(tmp_path) == [0, 1, 1, 2, 3]
+    assert integrity.verify_file(os.path.join(str(tmp_path), "ckpt_3.npz"))
+
+
+def test_hang_at_barrier_detected_by_watchdog(tmp_path):
+    """A peer that stops making progress (hang@barrier — indistinguishable
+    from a preempted host) must be detected by the survivor's watchdog within
+    the configured timeout, exiting 76 instead of blocking forever in the
+    next collective."""
+    timeout_s = 3.0
+    survivor = subprocess.Popen(
+        [sys.executable, "-u", HANG_WORKER, "0", "2", str(tmp_path)],
+        env=chaos_env(TPUDDP_WATCHDOG_TIMEOUT=timeout_s), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    hanger = subprocess.Popen(
+        [sys.executable, "-u", HANG_WORKER, "1", "2", str(tmp_path)],
+        env=chaos_env(
+            TPUDDP_WATCHDOG_TIMEOUT=timeout_s, TPUDDP_FAULT="hang@barrier"
+        ),
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        # bound: two jax imports + rendezvous + the 3s stale window; anything
+        # near the 120s ceiling means the watchdog failed and the test hung
+        out, err = survivor.communicate(timeout=120)
+        assert survivor.returncode == EXIT_WATCHDOG, (
+            f"exit {survivor.returncode}:\n{out[-1000:]}\n{err[-2000:]}"
+        )
+        assert "WORKER 0 armed" in out
+        assert "stale" in err  # the watchdog named the dead peer before exiting
+    finally:
+        hanger.kill()
+        hanger.communicate(timeout=30)
+    assert hanger.returncode is not None

@@ -110,4 +110,5 @@ def test_prng_key_shape_mismatch_raises(tmp_path):
 def test_no_tmp_file_left_behind(tmp_path):
     _, state = make_state()
     ckpt.save(str(tmp_path / "s.npz"), state)
-    assert os.listdir(tmp_path) == ["s.npz"]
+    # data file + its integrity manifest, and no .tmp staging remnants
+    assert sorted(os.listdir(tmp_path)) == ["s.npz", "s.npz.sha256"]

@@ -7,13 +7,14 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tpuddp.utils.compat import shard_map
 from tpuddp.parallel import collectives as col
 from tpuddp.parallel.mesh import DATA_AXIS
 
 
 def shmap(mesh, fn, in_specs, out_specs):
     return jax.jit(
-        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     )
 
 

@@ -9,6 +9,7 @@ import pytest
 import torch
 import torch.nn.functional as F
 
+from tpuddp.utils.compat import shard_map
 from tpuddp import nn
 
 KEY = jax.random.key(0)
@@ -139,7 +140,7 @@ def test_sync_batchnorm_equals_global_batch_stats(mesh):
         return y, ns
 
     y_sync, st_sync = jax.jit(
-        jax.shard_map(
+        shard_map(
             per_shard,
             mesh=mesh,
             in_specs=(P(), P(), P("data")),
@@ -248,7 +249,7 @@ def test_sync_batchnorm_weighted_equals_global_masked(mesh):
         return layer.apply(p, s, xs, ctx)
 
     y_sync, st_sync = jax.jit(
-        jax.shard_map(
+        shard_map(
             per_shard,
             mesh=mesh,
             in_specs=(P(), P(), P("data"), P("data")),

@@ -8,6 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from tpuddp.utils.compat import shard_map
 from tpuddp import seeding
 from tpuddp.parallel.mesh import DATA_AXIS
 
@@ -52,7 +53,7 @@ def test_fold_in_axis_index_diverges_per_replica(mesh):
         return jax.random.uniform(k, (1,))
 
     out = jax.jit(
-        jax.shard_map(draw, mesh=mesh, in_specs=None, out_specs=P(DATA_AXIS))
+        shard_map(draw, mesh=mesh, in_specs=None, out_specs=P(DATA_AXIS))
     )(key)
     vals = np.asarray(out)
     assert len(set(vals.tolist())) == 8  # every replica drew differently

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Run the full chaos (fault-injection) resilience suite.
+
+The chaos tier lives outside the tier-1 fast path (every chaos test is also
+marked slow): it kills subprocess training runs with SIGTERM, injects
+``$TPUDDP_FAULT`` crashes/hangs/corruption, and asserts the exit-code and
+auto-resume contracts documented in README "Fault tolerance".
+
+Usage: python tools/run_chaos.py [extra pytest args]
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # chaos runs never need a real TPU
+    cmd = [
+        sys.executable, "-m", "pytest", "tests", "-q",
+        "-m", "chaos",
+        "-p", "no:cacheprovider",
+        *(argv if argv is not None else sys.argv[1:]),
+    ]
+    return subprocess.call(cmd, cwd=REPO, env=env)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -59,6 +59,12 @@ TRAINING_DEFAULTS = {
     "num_classes": None,  # None -> derived from training.dataset
     "resume": False,  # restore the newest checkpoint from out_dir (native:
     # ckpt_{epoch}.npz full TrainState; managed: state_{epoch}.npz)
+    "auto_resume": False,  # resilience resume: restore the newest INTACT
+    # checkpoint at loop entry (corrupt ones skipped; a preemption-drain
+    # emergency save redoes its interrupted epoch). Env: TPUDDP_AUTO_RESUME=1
+    # lets a scheduler requeue the exact same command after exit 75.
+    "keep_last": None,  # checkpoint retention: prune all but the K newest
+    # ckpt_{epoch}.npz (+ .sha256 manifests) after each save; None keeps all
     "synthetic_n": None,  # (train, test) sizes for the synthetic dataset /
     # fallback; None -> (2048, 512)
 }

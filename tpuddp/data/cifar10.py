@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import shutil
 import tarfile
 from typing import Optional, Tuple
 
@@ -76,15 +77,54 @@ def find_cifar10(root: Optional[str] = None) -> Optional[Tuple[str, str]]:
     return None
 
 
-def _maybe_download(root: str) -> None:
-    archive = os.path.join(root, "cifar-10-python.tar.gz")
-    if not os.path.exists(archive):
-        import urllib.request
+def _remove_quietly(path: str) -> None:
+    try:
+        os.remove(path)
+    except FileNotFoundError:
+        pass
 
-        os.makedirs(root, exist_ok=True)
-        urllib.request.urlretrieve(URL, archive)  # no egress -> raises
-    with tarfile.open(archive, "r:gz") as tar:
-        tar.extractall(root)
+
+def _maybe_download(root: str) -> None:
+    """Fetch + extract the archive with retry/backoff (3 attempts, jittered —
+    flaky egress is the normal case on shared clusters). Downloads land in a
+    ``.part`` file first and are published by rename; a failed attempt removes
+    its partial file, and a corrupt archive (truncated by an earlier kill) is
+    deleted before the retry re-downloads — a bad attempt must not poison the
+    next run."""
+    from tpuddp.resilience.retry import RetryPolicy, retry
+
+    archive = os.path.join(root, "cifar-10-python.tar.gz")
+    os.makedirs(root, exist_ok=True)
+
+    def attempt():
+        if not os.path.exists(archive):
+            import urllib.request
+
+            part = archive + ".part"
+            try:
+                # urlretrieve has no timeout knob — a stalled connection would
+                # block attempt 1 forever and the retry wrapper would never
+                # run. Stream through urlopen with a socket timeout instead.
+                with urllib.request.urlopen(URL, timeout=60) as resp, open(
+                    part, "wb"
+                ) as out:
+                    shutil.copyfileobj(resp, out)
+                os.replace(part, archive)
+            except BaseException:
+                _remove_quietly(part)
+                raise
+        try:
+            with tarfile.open(archive, "r:gz") as tar:
+                tar.extractall(root)
+        except (tarfile.TarError, EOFError, OSError):
+            _remove_quietly(archive)
+            raise
+
+    retry(
+        attempt,
+        RetryPolicy(max_attempts=3, base_delay=1.0, max_delay=10.0),
+        describe=f"CIFAR-10 download from {URL} into {root}",
+    )
 
 
 class CIFAR10:

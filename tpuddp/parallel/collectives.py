@@ -113,11 +113,32 @@ def barrier(tag: str = "tpuddp_barrier", wait_for=None) -> None:
     On a single host, device work is ordered by XLA's async dispatch stream, so
     the barrier reduces to (optionally) blocking on in-flight values. Across
     hosts it is a real global rendezvous over DCN.
+
+    Resilience: entry is a ``$TPUDDP_FAULT`` injection site (``hang@barrier``
+    is the chaos suite's dead-peer scenario, detected by the heartbeat
+    watchdog). The rendezvous itself deliberately fails FAST: one host
+    retrying ``sync_global_devices`` alone after its peers already completed
+    the round would re-enter a rendezvous nobody else revisits — hanging
+    forever or pairing with the peers' *next* barrier and tripping the tag
+    assertion pod-wide. Transient-blip retry belongs where all hosts fail
+    together, i.e. the ``jax.distributed.initialize`` rendezvous in
+    ``backend.init_process_group``.
     """
+    from tpuddp.resilience import faults
+
+    faults.maybe_fire("barrier")
     if wait_for is not None:
         jax.block_until_ready(wait_for)
     if jax.process_count() > 1:
-        multihost_utils.sync_global_devices(tag)
+        try:
+            multihost_utils.sync_global_devices(tag)
+        except Exception as exc:
+            raise RuntimeError(
+                f"barrier {tag!r} failed on process {jax.process_index()}: "
+                f"{exc}. A mid-training barrier cannot be retried unilaterally "
+                "(peers have moved on); restart the run — auto_resume will "
+                "continue from the last checkpoint."
+            ) from exc
 
 
 def broadcast_one_to_all(pytree, is_source: Optional[bool] = None):

@@ -29,6 +29,8 @@ from typing import Callable, Optional
 import jax
 
 from tpuddp.parallel import backend as _backend
+from tpuddp.resilience import preemption as _preemption
+from tpuddp.resilience import watchdog as _watchdog
 
 logger = logging.getLogger("tpuddp")
 
@@ -128,6 +130,14 @@ def run_ddp_training(
     ``demo_fn(rank, world_size, save_dir, optional_args)`` runs once in this
     process; rank is the process index (0 on single host). Exceptions
     propagate like mp.spawn(join=True).
+
+    Resilience wiring (tpuddp.resilience): SIGTERM/SIGINT drain handlers are
+    installed before the worker runs, and a :class:`TrainingPreempted` raised
+    by the epoch driver (emergency checkpoint already written) becomes
+    ``sys.exit(75)`` — EX_TEMPFAIL, the "requeue me" code schedulers
+    understand. On the multi-host path, a heartbeat + stale-peer watchdog pair
+    is armed when ``$TPUDDP_WATCHDOG_TIMEOUT`` is set, so a dead peer surfaces
+    as exit 76 instead of a silent hang in the next collective.
     """
     multihost = coordinator_address is not None and (num_processes or 1) > 1
     if multihost:
@@ -135,6 +145,7 @@ def run_ddp_training(
         maybe_reexec_for_multihost_world(world_size, num_processes, backend)
     elif world_size is not None:
         maybe_reexec_for_world(world_size, backend)
+    _preemption.install_preemption_handler()
     _backend.setup(
         world_size=world_size,
         backend=backend,
@@ -142,7 +153,12 @@ def run_ddp_training(
         num_processes=num_processes,
         process_id=process_id,
     )
+    guard = _watchdog.start(save_dir, jax.process_index(), jax.process_count())
     try:
         demo_fn(jax.process_index(), _backend.get_world_size(), save_dir, optional_args)
+    except _preemption.TrainingPreempted as e:
+        logger.warning("%s; exiting %d (requeue+resume)", e, _preemption.EXIT_PREEMPTED)
+        sys.exit(_preemption.EXIT_PREEMPTED)
     finally:
+        _watchdog.stop(guard)
         _backend.cleanup()

@@ -1,0 +1,88 @@
+"""Resilience — the fault-tolerance layer the reference (and its HTCondor
+habitat) needs but never builds (ISSUE 1; PAPER.md §1).
+
+Under HTCondor — and on preemptible TPU pods — interruption is the *normal*
+failure mode, yet the reference's only recovery story is "rank 0 saves every N
+epochs".  This package makes survivable interruption a first-class subsystem,
+the way MLPerf-scale DDP work treats it (arxiv 1909.09756, 2509.07003):
+
+- ``preemption``  — SIGTERM/SIGINT -> flag -> emergency checkpoint -> exit 75
+                    (``$TPUDDP_PREEMPT_GRACE`` bounds the drain window); the
+                    epoch driver polls the flag at batch-group boundaries and
+                    ``run_training_loop(auto_resume=True)`` continues from the
+                    recorded epoch on restart.
+- ``integrity``   — sha256 sidecar manifests for checkpoints; ``latest()``
+                    verifies and *skips* corrupt/truncated files instead of
+                    crashing on them, and ``keep_last`` pruning bounds disk.
+- ``retry``       — jittered-exponential-backoff ``retry(fn, policy)`` used by
+                    backend init, the CIFAR-10 download, and barrier entry.
+- ``faults``      — ``$TPUDDP_FAULT`` chaos-injection hooks (``crash@epoch=2``,
+                    ``preempt@epoch=1``, ``hang@barrier``, ``corrupt@ckpt_1``)
+                    that the chaos test suite drives via subprocess kills.
+- ``watchdog``    — heartbeat files + a stale-peer watchdog thread for the
+                    multi-host path (``$TPUDDP_WATCHDOG_TIMEOUT``), so a dead
+                    peer surfaces as a logged exit instead of a silent hang in
+                    a collective.
+"""
+
+from tpuddp.resilience.preemption import (  # noqa: F401
+    EXIT_INJECTED_CRASH,
+    auto_resume_requested,
+    EXIT_PREEMPTED,
+    EXIT_WATCHDOG,
+    TrainingPreempted,
+    install_preemption_handler,
+    preemption_grace_seconds,
+    preemption_requested,
+    request_preemption,
+    reset_preemption,
+    uninstall_preemption_handler,
+)
+from tpuddp.resilience.retry import RetryError, RetryPolicy, retry  # noqa: F401
+from tpuddp.resilience.faults import (  # noqa: F401
+    FaultSpec,
+    active_faults,
+    maybe_fire,
+    parse_fault_specs,
+    reload_faults,
+)
+from tpuddp.resilience.watchdog import (  # noqa: F401
+    Heartbeat,
+    Watchdog,
+    WatchdogTimeout,
+    watchdog_timeout_seconds,
+)
+from tpuddp.resilience.integrity import (  # noqa: F401
+    manifest_path,
+    verify_file,
+    write_manifest,
+)
+
+__all__ = [
+    "EXIT_INJECTED_CRASH",
+    "auto_resume_requested",
+    "EXIT_PREEMPTED",
+    "EXIT_WATCHDOG",
+    "TrainingPreempted",
+    "install_preemption_handler",
+    "preemption_grace_seconds",
+    "preemption_requested",
+    "request_preemption",
+    "reset_preemption",
+    "uninstall_preemption_handler",
+    "RetryError",
+    "RetryPolicy",
+    "retry",
+    "FaultSpec",
+    "active_faults",
+    "maybe_fire",
+    "parse_fault_specs",
+    "reload_faults",
+    "Heartbeat",
+    "Watchdog",
+    "WatchdogTimeout",
+    "watchdog_timeout_seconds",
+    "manifest_path",
+    "verify_file",
+    "write_manifest",
+]

@@ -1,0 +1,136 @@
+"""Fault injection — ``$TPUDDP_FAULT`` chaos hooks.
+
+The chaos suite (tests/test_chaos.py) needs to place a failure at an exact
+point in a *subprocess* training run; env-driven injection is the only channel
+that crosses the process boundary without patching code.  Grammar::
+
+    TPUDDP_FAULT=<kind>@<site>[,<kind>@<site>...]
+
+    kinds:  crash    os._exit(EXIT_INJECTED_CRASH) — the unclean kill
+            preempt  SIGTERM to self — drives the real drain path
+            hang     stop heartbeating and sleep forever — the dead peer
+            corrupt  garbage the just-written checkpoint file
+
+    sites:  epoch=N  checked by the epoch driver at the start of epoch N
+            barrier  checked on entry to collectives.barrier
+            ckpt_N   checked after checkpoint ``ckpt_N.npz`` is published
+
+Examples: ``crash@epoch=2``, ``preempt@epoch=1``, ``hang@barrier``,
+``corrupt@ckpt_1``.  Each spec fires at most once per process.  Parsing is
+lazy and cached; :func:`reload_faults` re-reads the env (test isolation).
+Production runs without the env variable pay one cached dict lookup per hook.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import time
+from typing import List, Optional
+
+from tpuddp.resilience.preemption import EXIT_INJECTED_CRASH
+
+logger = logging.getLogger("tpuddp")
+
+_FAULT_ENV = "TPUDDP_FAULT"
+_KINDS = ("crash", "preempt", "hang", "corrupt")
+
+_cache = {"raw": None, "specs": None}
+_hung = {"active": False}
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    kind: str  # one of _KINDS
+    site: str  # "epoch" | "barrier" | "ckpt"
+    arg: Optional[str]  # epoch number / checkpoint stem, None for barrier
+    fired: bool = False
+
+    def matches(self, site: str, **ctx) -> bool:
+        if self.fired or site != self.site:
+            return False
+        if self.site == "epoch":
+            return str(ctx.get("epoch")) == self.arg
+        if self.site == "ckpt":
+            return ctx.get("name") == self.arg
+        return True  # barrier (and other argless sites)
+
+
+def parse_fault_specs(raw: str) -> List[FaultSpec]:
+    specs = []
+    for part in filter(None, (p.strip() for p in raw.split(","))):
+        try:
+            kind, point = part.split("@", 1)
+        except ValueError:
+            raise ValueError(
+                f"bad {_FAULT_ENV} spec {part!r}: expected <kind>@<site>"
+            ) from None
+        if kind not in _KINDS:
+            raise ValueError(
+                f"bad {_FAULT_ENV} kind {kind!r}; one of {_KINDS}"
+            )
+        if point.startswith("epoch="):
+            specs.append(FaultSpec(kind, "epoch", point[len("epoch=") :]))
+        elif point == "barrier":
+            specs.append(FaultSpec(kind, "barrier", None))
+        elif point.startswith("ckpt"):
+            specs.append(FaultSpec(kind, "ckpt", point))
+        else:
+            raise ValueError(
+                f"bad {_FAULT_ENV} site {point!r}; expected epoch=N, barrier, "
+                "or ckpt_N"
+            )
+    return specs
+
+
+def active_faults() -> List[FaultSpec]:
+    raw = os.environ.get(_FAULT_ENV, "")
+    if raw != _cache["raw"]:
+        _cache["raw"] = raw
+        _cache["specs"] = parse_fault_specs(raw) if raw else []
+    return _cache["specs"]
+
+
+def reload_faults() -> None:
+    _cache.update(raw=None, specs=None)
+    _hung["active"] = False
+
+
+def is_hung() -> bool:
+    """True once a ``hang`` fault fired — the heartbeat thread checks this and
+    stops beating, so the hang is visible to peer watchdogs as a dead process
+    would be."""
+    return _hung["active"]
+
+
+def _corrupt_file(path: str) -> None:
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(0)
+        f.write(b"\x00CHAOS\x00" * 4)
+        f.truncate(max(32, size // 2))  # torn write: header garbage + tail gone
+
+
+def maybe_fire(site: str, **ctx) -> None:
+    """Injection hook. No-op unless an un-fired ``$TPUDDP_FAULT`` spec matches
+    ``site`` (+``ctx``); called from the epoch driver, barrier entry, and the
+    checkpoint writer."""
+    for spec in active_faults():
+        if not spec.matches(site, **ctx):
+            continue
+        spec.fired = True
+        logger.critical("fault injection: %s@%s fired (ctx=%s)", spec.kind, site, ctx)
+        if spec.kind == "crash":
+            os._exit(EXIT_INJECTED_CRASH)
+        elif spec.kind == "preempt":
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif spec.kind == "hang":
+            _hung["active"] = True
+            while True:  # a peer's watchdog (or the test harness) must kill us
+                time.sleep(1.0)
+        elif spec.kind == "corrupt":
+            path = ctx.get("path")
+            if path and os.path.exists(path):
+                _corrupt_file(path)

@@ -1,0 +1,101 @@
+"""Checkpoint integrity — sha256 sidecar manifests.
+
+A preempted or crashed writer can leave a torn file even past the atomic
+``os.replace`` (e.g. a node dies mid-flush on a network filesystem, or a
+chaos ``corrupt@ckpt_N`` fault fires).  Every checkpoint save publishes a
+``<file>.sha256`` sidecar (digest + size, written atomically *after* the data
+file); ``checkpoint.latest()`` verifies before trusting a candidate and falls
+back to the next-newest instead of crashing the resume path.
+
+Manifest format is the ``sha256sum``-compatible line ``<hex>  <basename>``
+with an optional ``# size=<bytes>`` second line, so operators can verify with
+coreutils.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("tpuddp")
+
+_CHUNK = 1024 * 1024
+
+
+def manifest_path(path: str) -> str:
+    return path + ".sha256"
+
+
+def _digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(_CHUNK), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_manifest(path: str) -> str:
+    """Write ``<path>.sha256`` (atomically: tmp + replace). Returns its path."""
+    mpath = manifest_path(path)
+    digest = _digest(path)
+    size = os.path.getsize(path)
+    tmp = mpath + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{digest}  {os.path.basename(path)}\n# size={size}\n")
+    os.replace(tmp, mpath)
+    return mpath
+
+
+def read_manifest(path: str) -> Optional[dict]:
+    """Parse ``<path>.sha256`` -> {"digest", "size"}; None if absent/garbled."""
+    mpath = manifest_path(path)
+    if not os.path.exists(mpath):
+        return None
+    try:
+        with open(mpath) as f:
+            lines = f.read().splitlines()
+        digest = lines[0].split()[0]
+        size = None
+        for line in lines[1:]:
+            if line.startswith("# size="):
+                size = int(line[len("# size=") :])
+        return {"digest": digest, "size": size}
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def verify_file(path: str, require_manifest: bool = False) -> bool:
+    """True when ``path`` exists and matches its manifest. Without a manifest
+    (pre-resilience checkpoints): a cheap structural check — non-empty and
+    zip-magic-prefixed (every .npz is a zip) — unless ``require_manifest``."""
+    if not os.path.exists(path):
+        return False
+    manifest = read_manifest(path)
+    if manifest is None:
+        if require_manifest:
+            return False
+        try:
+            if os.path.getsize(path) == 0:
+                return False
+            with open(path, "rb") as f:
+                return f.read(2) == b"PK"  # zip local-file-header magic
+        except OSError:
+            return False
+    try:
+        if manifest["size"] is not None and os.path.getsize(path) != manifest["size"]:
+            logger.warning(
+                "integrity: %s size %d != manifest size %d (truncated?)",
+                path,
+                os.path.getsize(path),
+                manifest["size"],
+            )
+            return False
+        if _digest(path) != manifest["digest"]:
+            logger.warning("integrity: %s sha256 mismatch vs manifest", path)
+            return False
+    except OSError as e:
+        logger.warning("integrity: cannot verify %s (%s)", path, e)
+        return False
+    return True

@@ -1,0 +1,165 @@
+"""Preemption-safe training — the SIGTERM/SIGINT drain path.
+
+HTCondor (the reference's scheduler, submit_job.py) and preemptible TPU pods
+both deliver SIGTERM, wait a grace window, then SIGKILL.  The contract here:
+
+1. :func:`install_preemption_handler` (called by ``spawn.run_ddp_training``
+   and the managed entrypoint) registers handlers that only *set a flag* —
+   signal handlers must not run collectives or touch XLA.
+2. The epoch driver polls :func:`preemption_requested` at batch-group
+   boundaries, writes an emergency checkpoint through the existing atomic
+   ``checkpoint.save()`` (params + optimizer state + epoch + sampler epoch +
+   RNG state travel inside the TrainState; the epoch lands in the checkpoint's
+   meta record), and raises :class:`TrainingPreempted`.
+3. ``spawn.run_ddp_training`` converts that into ``sys.exit(EXIT_PREEMPTED)``
+   — exit code 75 (BSD ``EX_TEMPFAIL``), the "requeue me" signal schedulers
+   understand.
+4. A daemon failsafe timer started at signal time force-exits with the same
+   code after ``$TPUDDP_PREEMPT_GRACE`` seconds (default 25), so a drain that
+   wedges (e.g. a collective that never completes) still beats the SIGKILL
+   and still reports the distinct code.
+
+A second signal during the drain exits immediately: the operator (or the
+scheduler escalating) asked twice.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger("tpuddp")
+
+# Exit-code contract (README "Fault tolerance"). 75 = EX_TEMPFAIL, the
+# conventional "transient, requeue" code; 76/113 are tpuddp-specific but
+# chosen outside the shell/signal ranges (126-165) and common tool codes.
+EXIT_PREEMPTED = 75  # drained after SIGTERM/SIGINT; safe to requeue + resume
+EXIT_WATCHDOG = 76  # a peer's heartbeat went stale; this process bailed out
+EXIT_INJECTED_CRASH = 113  # $TPUDDP_FAULT crash@... fired (chaos tests only)
+
+_GRACE_ENV = "TPUDDP_PREEMPT_GRACE"
+_DEFAULT_GRACE = 25.0
+_AUTO_RESUME_ENV = "TPUDDP_AUTO_RESUME"
+
+_flag = threading.Event()
+_state = {
+    "installed": False,
+    "previous": {},  # signum -> previous handler
+    "signum": None,
+    "deadline": None,
+    "failsafe": None,
+}
+
+
+class TrainingPreempted(Exception):
+    """Raised by the epoch driver after a successful emergency save.
+
+    ``epoch`` is the epoch that was interrupted (resume restarts it);
+    ``checkpoint`` is the emergency checkpoint path on process 0, None
+    elsewhere (or when no save_dir was configured).
+    """
+
+    def __init__(self, epoch: int, checkpoint: Optional[str] = None):
+        self.epoch = epoch
+        self.checkpoint = checkpoint
+        super().__init__(
+            f"training preempted during epoch {epoch}"
+            + (f"; emergency checkpoint at {checkpoint}" if checkpoint else "")
+        )
+
+
+def auto_resume_requested() -> bool:
+    """The scheduler-requeue contract: ``$TPUDDP_AUTO_RESUME`` truthy (any
+    value but empty/"0") asks the run to restore the newest intact checkpoint
+    at loop entry. One parser for both entrypoints."""
+    return os.environ.get(_AUTO_RESUME_ENV, "") not in ("", "0")
+
+
+def preemption_grace_seconds() -> float:
+    """The SIGTERM->forced-exit drain budget ($TPUDDP_PREEMPT_GRACE, s)."""
+    raw = os.environ.get(_GRACE_ENV, "")
+    try:
+        return float(raw) if raw else _DEFAULT_GRACE
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", _GRACE_ENV, raw)
+        return _DEFAULT_GRACE
+
+
+def _failsafe(grace: float) -> None:
+    time.sleep(grace)
+    if _flag.is_set():  # drain did not finish in time; beat the SIGKILL
+        logger.critical(
+            "preemption drain exceeded the %.0fs grace window; forcing exit %d",
+            grace,
+            EXIT_PREEMPTED,
+        )
+        os._exit(EXIT_PREEMPTED)
+
+
+def request_preemption(signum: Optional[int] = None, frame=None) -> None:
+    """The handler body (also callable directly, e.g. by fault injection):
+    set the flag, arm the grace-window failsafe, never touch the runtime."""
+    if _flag.is_set():
+        # second signal: the scheduler/operator escalated — exit now
+        logger.critical("second preemption signal; exiting immediately")
+        os._exit(EXIT_PREEMPTED)
+    grace = preemption_grace_seconds()
+    _flag.set()
+    _state["signum"] = signum
+    _state["deadline"] = time.monotonic() + grace
+    name = signal.Signals(signum).name if signum is not None else "request"
+    logger.warning(
+        "%s received: draining — emergency checkpoint at the next batch-group "
+        "boundary, then exit %d (grace %.0fs)",
+        name,
+        EXIT_PREEMPTED,
+        grace,
+    )
+    t = threading.Thread(
+        target=_failsafe, args=(grace,), name="tpuddp-preempt-failsafe", daemon=True
+    )
+    t.start()
+    _state["failsafe"] = t
+
+
+def preemption_requested() -> bool:
+    return _flag.is_set()
+
+
+def preemption_deadline() -> Optional[float]:
+    """``time.monotonic()`` deadline of the drain window, None if not draining."""
+    return _state["deadline"] if _flag.is_set() else None
+
+
+def install_preemption_handler(signals=(signal.SIGTERM, signal.SIGINT)) -> bool:
+    """Register the drain handlers. Main-thread only (a Python limitation);
+    returns False (and stays a no-op) elsewhere, e.g. under a test runner
+    driving workers from helper threads."""
+    if threading.current_thread() is not threading.main_thread():
+        logger.debug("not main thread; preemption handler not installed")
+        return False
+    if _state["installed"]:
+        return True
+    for s in signals:
+        _state["previous"][s] = signal.signal(s, request_preemption)
+    _state["installed"] = True
+    return True
+
+
+def uninstall_preemption_handler() -> None:
+    if not _state["installed"]:
+        return
+    for s, prev in _state["previous"].items():
+        signal.signal(s, prev)
+    _state["previous"].clear()
+    _state["installed"] = False
+
+
+def reset_preemption() -> None:
+    """Clear the flag/deadline (test isolation; a real process exits instead)."""
+    _flag.clear()
+    _state.update(signum=None, deadline=None, failsafe=None)

@@ -1,0 +1,88 @@
+"""Retry with jittered exponential backoff — the transient-failure primitive.
+
+Used where the framework touches the world outside its own process and a
+one-shot failure is routinely recoverable: ``jax.distributed.initialize``
+rendezvous (peers race to come up), the CIFAR-10 download (flaky egress), and
+multi-host barrier entry.  Jitter decorrelates the retry storms of N hosts
+that all saw the same transient (the classic thundering-herd fix).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+logger = logging.getLogger("tpuddp")
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """``delay(attempt) = min(max_delay, base_delay * 2**(attempt-1))``, then
+    multiplied by ``uniform(1 - jitter, 1 + jitter)``. ``retry_on`` bounds
+    which exception types count as transient."""
+
+    max_attempts: int = 3
+    base_delay: float = 0.5
+    max_delay: float = 30.0
+    jitter: float = 0.5  # fraction of the delay, in [0, 1]
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,)
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {self.max_attempts}")
+        if not (0.0 <= self.jitter <= 1.0):
+            raise ValueError(f"jitter must be in [0, 1], got {self.jitter}")
+
+    def delay(self, attempt: int, rng: Optional[random.Random] = None) -> float:
+        base = min(self.max_delay, self.base_delay * (2.0 ** (attempt - 1)))
+        r = rng.uniform if rng is not None else random.uniform
+        return base * r(1.0 - self.jitter, 1.0 + self.jitter)
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted. ``__cause__`` is the final attempt's exception;
+    the message names the operation and attempt count so the terminal error is
+    actionable, not just the last traceback."""
+
+
+def retry(
+    fn: Callable,
+    policy: Optional[RetryPolicy] = None,
+    *,
+    describe: str = "operation",
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Call ``fn()`` up to ``policy.max_attempts`` times. Non-``retry_on``
+    exceptions (and KeyboardInterrupt/SystemExit, which are never transient)
+    propagate immediately; exhaustion raises :class:`RetryError` chaining the
+    last failure."""
+    policy = policy or RetryPolicy()
+    last: Optional[BaseException] = None
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            return fn()
+        except policy.retry_on as e:
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                raise
+            last = e
+            if attempt == policy.max_attempts:
+                break
+            d = policy.delay(attempt)
+            logger.warning(
+                "%s failed (attempt %d/%d): %s — retrying in %.1fs",
+                describe,
+                attempt,
+                policy.max_attempts,
+                e,
+                d,
+            )
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(d)
+    raise RetryError(
+        f"{describe} failed after {policy.max_attempts} attempt(s): {last}"
+    ) from last

@@ -1,0 +1,250 @@
+"""Multi-host watchdog — heartbeat files + stale-peer detection.
+
+The failure the reference cannot even see: one host of a pod dies (preempted,
+OOM-killed, network-partitioned) and every other host blocks *forever* inside
+the next collective — no error, no log, the job just stops consuming epochs.
+jax's own collectives have no per-op timeout on the DCN path, so detection has
+to live beside them:
+
+- each process runs a :class:`Heartbeat` thread that rewrites
+  ``<dir>/hb_<process_id>`` (atomic tmp+replace, wall-clock content — mtime is
+  unreliable on NFS) every ``interval`` seconds;
+- a :class:`Watchdog` thread checks the peers' files and, when one goes stale
+  past ``$TPUDDP_WATCHDOG_TIMEOUT`` seconds, logs which peer died and how
+  stale it is, then acts: ``action="exit"`` (default) leaves with
+  ``EXIT_WATCHDOG`` (76) so the scheduler can requeue + auto-resume the whole
+  job, ``action="raise"`` interrupts the main thread, a callable gets the
+  stale list.
+
+The heartbeat dir defaults to ``<save_dir>/.heartbeats`` (the checkpoint dir
+is already the shared-filesystem rendezvous point on pods);
+``$TPUDDP_HEARTBEAT_DIR`` overrides.  A ``hang`` fault (faults.is_hung) stops
+the beat without stopping the process — the injected hang is indistinguishable
+from a dead peer, which is the point of the chaos test.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Tuple, Union
+
+from tpuddp.resilience import faults
+from tpuddp.resilience.preemption import EXIT_WATCHDOG
+
+logger = logging.getLogger("tpuddp")
+
+_TIMEOUT_ENV = "TPUDDP_WATCHDOG_TIMEOUT"
+_DIR_ENV = "TPUDDP_HEARTBEAT_DIR"
+
+
+class WatchdogTimeout(RuntimeError):
+    """A peer's heartbeat went stale past the configured timeout."""
+
+
+def watchdog_timeout_seconds() -> Optional[float]:
+    """$TPUDDP_WATCHDOG_TIMEOUT in seconds; None/invalid/<=0 disables."""
+    raw = os.environ.get(_TIMEOUT_ENV, "")
+    if not raw:
+        return None
+    try:
+        t = float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", _TIMEOUT_ENV, raw)
+        return None
+    return t if t > 0 else None
+
+
+def heartbeat_dir(save_dir: Optional[str]) -> Optional[str]:
+    env = os.environ.get(_DIR_ENV)
+    if env:
+        return env
+    if save_dir:
+        return os.path.join(save_dir, ".heartbeats")
+    return None
+
+
+def _hb_path(directory: str, process_id: int) -> str:
+    return os.path.join(directory, f"hb_{process_id}")
+
+
+def write_heartbeat(directory: str, process_id: int, now: Optional[float] = None) -> str:
+    path = _hb_path(directory, process_id)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{time.time() if now is None else now:.6f}\n")
+    os.replace(tmp, path)
+    return path
+
+
+def read_heartbeat(directory: str, process_id: int) -> Optional[float]:
+    try:
+        with open(_hb_path(directory, process_id)) as f:
+            return float(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+class Heartbeat:
+    """Daemon thread publishing this process's liveness file."""
+
+    def __init__(self, directory: str, process_id: int, interval: float = 1.0):
+        self.directory = directory
+        self.process_id = int(process_id)
+        self.interval = float(interval)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Heartbeat":
+        os.makedirs(self.directory, exist_ok=True)
+        write_heartbeat(self.directory, self.process_id)  # beat before returning
+        self._thread = threading.Thread(
+            target=self._run, name="tpuddp-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            if faults.is_hung():
+                continue  # injected hang: look exactly like a dead peer
+            try:
+                write_heartbeat(self.directory, self.process_id)
+            except OSError as e:  # shared FS hiccup: log, keep beating
+                logger.warning("heartbeat write failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+def start(
+    save_dir: Optional[str],
+    process_id: int,
+    num_processes: int,
+    interval: float = 1.0,
+) -> Optional[Tuple["Heartbeat", "Watchdog"]]:
+    """Start this process's heartbeat + stale-peer watchdog pair — the wiring
+    ``spawn.run_ddp_training`` uses on the multi-host path. Returns None (fully
+    disabled) unless ``$TPUDDP_WATCHDOG_TIMEOUT`` is set, there are peers to
+    watch, and a shared directory is resolvable (``$TPUDDP_HEARTBEAT_DIR`` or
+    ``<save_dir>/.heartbeats``). Pass the pair to :func:`stop` on the way out."""
+    timeout = watchdog_timeout_seconds()
+    if timeout is None or num_processes <= 1:
+        return None
+    directory = heartbeat_dir(save_dir)
+    if directory is None:
+        logger.warning(
+            "%s set but no heartbeat dir resolvable (no save_dir and no %s); "
+            "watchdog disabled",
+            _TIMEOUT_ENV,
+            _DIR_ENV,
+        )
+        return None
+    hb = Heartbeat(directory, process_id, interval=interval).start()
+    wd = Watchdog(directory, process_id, num_processes, timeout).start()
+    logger.info(
+        "watchdog armed: %d-process heartbeat dir %s, timeout %.1fs",
+        num_processes,
+        directory,
+        timeout,
+    )
+    return hb, wd
+
+
+def stop(pair: Optional[Tuple["Heartbeat", "Watchdog"]]) -> None:
+    """Tear down a :func:`start` pair (None-safe)."""
+    if pair is None:
+        return
+    hb, wd = pair
+    wd.stop()
+    hb.stop()
+
+
+class Watchdog:
+    """Daemon thread that detects stale peers.
+
+    ``action``: ``"exit"`` (os._exit(EXIT_WATCHDOG) — the only escape that
+    works while the main thread is wedged GIL-free inside a collective),
+    ``"raise"`` (interrupt the main thread; fine for interruptible waits), or
+    a callable receiving ``[(peer_id, age_seconds), ...]``.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        process_id: int,
+        num_processes: int,
+        timeout: float,
+        action: Union[str, Callable] = "exit",
+        interval: Optional[float] = None,
+    ):
+        self.directory = directory
+        self.process_id = int(process_id)
+        self.num_processes = int(num_processes)
+        self.timeout = float(timeout)
+        self.action = action
+        self.interval = float(interval) if interval else max(0.25, self.timeout / 4.0)
+        self._started_at = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def check_once(self, now: Optional[float] = None) -> List[Tuple[int, float]]:
+        """Stale peers as ``(peer_id, age_seconds)``. A peer with no file yet
+        is only stale once the timeout has elapsed since the watchdog started
+        (startup grace — peers finish rendezvous at slightly different times)."""
+        now = time.time() if now is None else now
+        started = self._started_at if self._started_at is not None else now
+        stale = []
+        for peer in range(self.num_processes):
+            if peer == self.process_id:
+                continue
+            beat = read_heartbeat(self.directory, peer)
+            if beat is None:
+                if now - started > self.timeout:
+                    stale.append((peer, now - started))
+            elif now - beat > self.timeout:
+                stale.append((peer, now - beat))
+        return stale
+
+    def start(self) -> "Watchdog":
+        self._started_at = time.time()
+        self._thread = threading.Thread(
+            target=self._run, name="tpuddp-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                stale = self.check_once()
+            except OSError as e:
+                logger.warning("watchdog scan failed: %s", e)
+                continue
+            if stale:
+                self._fire(stale)
+                return
+
+    def _fire(self, stale: List[Tuple[int, float]]) -> None:
+        desc = ", ".join(f"process {p} ({age:.1f}s stale)" for p, age in stale)
+        logger.critical(
+            "watchdog: peer heartbeat stale past %.1fs — %s; a dead peer wedges "
+            "every collective, so this process will not wait",
+            self.timeout,
+            desc,
+        )
+        if callable(self.action):
+            self.action(stale)
+        elif self.action == "raise":
+            threading.interrupt_main()
+        else:
+            os._exit(EXIT_WATCHDOG)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -15,32 +15,51 @@ Format: a single ``.npz`` holding flattened leaves keyed by their pytree
 paths. PRNG key arrays are stored via ``jax.random.key_data`` and re-wrapped
 on load. Loading requires a template ("like") pytree for the treedef — the
 natural JAX analog of ``model.load_state_dict``.
+
+Resilience (ISSUE 1): every save publishes a ``.sha256`` sidecar manifest;
+``latest()`` verifies candidates newest-first and *skips* corrupt/truncated
+files with a logged warning instead of crashing the resume path; a small
+``__meta__*`` record inside the npz distinguishes end-of-epoch checkpoints
+(``completed=1`` -> resume at epoch+1) from preemption-drain emergency saves
+(``completed=0`` -> redo the interrupted epoch); ``keep_last`` pruning bounds
+checkpoint disk on long runs.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import re
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import ml_dtypes
 import numpy as np
 
 from tpuddp.parallel import collectives as col
+from tpuddp.resilience import faults, integrity
+
+logger = logging.getLogger("tpuddp")
 
 _KEY_MARK = "__prngkey__"
 _BF16_MARK = "__bf16__"  # npz can't serialize ml_dtypes natively (loads back
 # as void16); bf16 leaves — e.g. Adam moments under optimizer_state_dtype —
 # are stored as a uint16 bit view and re-viewed on load.
+_META_MARK = "__meta__"  # scalar bookkeeping (epoch, completed flag) stored
+# alongside the leaves; load() iterates the template's leaves so meta keys are
+# invisible to it, and read_meta() reads them without needing a template.
 
 
 def _path_str(path) -> str:
     return jax.tree_util.keystr(path)
 
 
-def save(path: str, tree: Any) -> str:
-    """Serialize a pytree to ``path`` (.npz). Caller handles rank gating."""
+def save(path: str, tree: Any, meta: Optional[Dict[str, int]] = None) -> str:
+    """Serialize a pytree to ``path`` (.npz). Caller handles rank gating.
+    ``meta``: optional dict of int scalars (e.g. epoch, completed) stored as
+    ``__meta__*`` entries, readable via :func:`read_meta` without a template.
+    A ``.sha256`` manifest sidecar is published after the data file so
+    ``latest()`` can verify integrity before trusting a checkpoint."""
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     payload = {}
     for p, leaf in flat:
@@ -52,11 +71,24 @@ def save(path: str, tree: Any) -> str:
             payload[_BF16_MARK + key] = np.asarray(arr).view(np.uint16)
         else:
             payload[key] = np.asarray(arr)
+    for k, v in (meta or {}).items():
+        payload[_META_MARK + k] = np.asarray(int(v), dtype=np.int64)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
     os.replace(tmp, path)  # atomic publish, no torn checkpoints
+    integrity.write_manifest(path)
     return path
+
+
+def read_meta(path: str) -> Dict[str, int]:
+    """The ``__meta__*`` scalars of a checkpoint (empty for pre-meta files)."""
+    out: Dict[str, int] = {}
+    with np.load(path) as data:
+        for k in data.files:
+            if k.startswith(_META_MARK):
+                out[k[len(_META_MARK) :]] = int(data[k])
+    return out
 
 
 def _check_leaf(path: str, key: str, stored: np.ndarray, template: Any) -> np.ndarray:
@@ -146,43 +178,106 @@ def _gather_cross_host_shards(tree: Any) -> Any:
 
 
 def save_on_main(
-    save_dir: str, epoch: int, tree: Any, prefix: str = "ckpt"
+    save_dir: str,
+    epoch: int,
+    tree: Any,
+    prefix: str = "ckpt",
+    completed: bool = True,
+    keep_last: Optional[int] = None,
 ) -> Optional[str]:
     """Process-0-only save + barrier — the reference's writer discipline
     (:217-223), with the cross-host shard gather (a collective) BEFORE the
     process-0 gate. Returns the path on process 0, None elsewhere. The
-    managed full-state files use ``prefix="state"``."""
+    managed full-state files use ``prefix="state"``.
+
+    ``completed=False`` marks a preemption-drain emergency save (resume redoes
+    ``epoch`` instead of starting at ``epoch + 1``); ``keep_last=K`` prunes all
+    but the K newest epochs after a successful save."""
     if jax.process_count() > 1:
         tree = _gather_cross_host_shards(tree)
     path = None
     if jax.process_index() == 0:
         os.makedirs(save_dir, exist_ok=True)
-        path = save(checkpoint_path(save_dir, epoch, prefix), tree)
+        path = save(
+            checkpoint_path(save_dir, epoch, prefix),
+            tree,
+            meta={"epoch": epoch, "completed": int(completed)},
+        )
+        # chaos hook: corrupt@ckpt_N garbles the just-published file (stale
+        # manifest included), which latest() must then detect and skip
+        faults.maybe_fire("ckpt", name=f"{prefix}_{epoch}", path=path)
+        if keep_last is not None:
+            prune_checkpoints(save_dir, keep_last, prefix)
     col.barrier("tpuddp_checkpoint")
     return path
 
 
-def latest(save_dir: str, prefix: str = "ckpt") -> Optional[Tuple[str, int]]:
-    """Most recent ``(path, epoch)`` in ``save_dir``, or None. The resume
-    helper the reference lacks (SURVEY.md §3.4)."""
+def _all_checkpoints(save_dir: str, prefix: str = "ckpt") -> List[Tuple[str, int]]:
+    """All ``(path, epoch)`` matches, newest epoch first."""
     if not os.path.isdir(save_dir):
-        return None
+        return []
     pat = re.compile(rf"^{re.escape(prefix)}_(\d+)\.npz$")
-    best = None
+    found = []
     for name in os.listdir(save_dir):
         m = pat.match(name)
         if m:
-            epoch = int(m.group(1))
-            if best is None or epoch > best[1]:
-                best = (os.path.join(save_dir, name), epoch)
-    return best
+            found.append((os.path.join(save_dir, name), int(m.group(1))))
+    found.sort(key=lambda t: t[1], reverse=True)
+    return found
+
+
+def latest(save_dir: str, prefix: str = "ckpt") -> Optional[Tuple[str, int]]:
+    """Most recent *intact* ``(path, epoch)`` in ``save_dir``, or None. The
+    resume helper the reference lacks (SURVEY.md §3.4). Candidates that fail
+    integrity verification (manifest mismatch, truncation, a writer killed
+    mid-``save``) are skipped with a warning in favor of the next-newest good
+    one — a corrupt newest checkpoint must not take down the resume path."""
+    for path, epoch in _all_checkpoints(save_dir, prefix):
+        if integrity.verify_file(path):
+            return path, epoch
+        logger.warning(
+            "checkpoint %s failed integrity verification (corrupt or "
+            "truncated); skipping it and falling back to the next-newest",
+            path,
+        )
+    return None
+
+
+def prune_checkpoints(save_dir: str, keep_last: int, prefix: str = "ckpt") -> int:
+    """Delete all but the ``keep_last`` newest ``{prefix}_*.npz`` (and their
+    manifests). Returns the number of checkpoints removed."""
+    if keep_last < 1:
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    removed = 0
+    for path, _epoch in _all_checkpoints(save_dir, prefix)[keep_last:]:
+        for p in (path, integrity.manifest_path(path)):
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+        removed += 1
+        logger.info("pruned old checkpoint %s (keep_last=%d)", path, keep_last)
+    return removed
 
 
 def restore_latest(save_dir: str, like: Any, prefix: str = "ckpt") -> Tuple[Any, int]:
-    """Load the newest checkpoint into ``like``'s structure. Returns
-    ``(tree, next_epoch)``; ``(like, 0)`` when none exists."""
+    """Load the newest intact checkpoint into ``like``'s structure. Returns
+    ``(tree, next_epoch)``; ``(like, 0)`` when none exists. An emergency save
+    (``completed=0`` meta, written during a preemption drain) yields its own
+    epoch as ``next_epoch`` so the interrupted epoch is redone from the saved
+    mid-epoch state; end-of-epoch saves yield ``epoch + 1``."""
     found = latest(save_dir, prefix)
     if found is None:
         return like, 0
     path, epoch = found
-    return load(path, like), epoch + 1
+    tree = load(path, like)
+    meta = read_meta(path)
+    if not meta.get("completed", 1):
+        logger.warning(
+            "resuming from EMERGENCY checkpoint %s (preempted during epoch "
+            "%d); that epoch restarts from the saved mid-epoch state",
+            path,
+            epoch,
+        )
+        return tree, epoch
+    return tree, epoch + 1

@@ -26,6 +26,12 @@ import numpy as np
 
 from tpuddp import seeding
 from tpuddp.parallel import collectives as col
+from tpuddp.resilience import faults
+from tpuddp.resilience.preemption import (
+    TrainingPreempted,
+    auto_resume_requested,
+    preemption_requested,
+)
 from tpuddp.training import checkpoint as ckpt
 from tpuddp.training.step import accumulate_metrics, finalize_metrics, stack_batches
 from tpuddp.utils.observability import (
@@ -98,9 +104,13 @@ def _pad_to_cycles(chunk, accum: int):
     return chunk + [(x0, y0, np.zeros_like(w0))] * pad
 
 
+def _never():
+    return False
+
+
 def _fused_pass(
     ddp, state, loader, scan_k: int, step_one, step_many, probe_cb=None,
-    accum: int = 1,
+    accum: int = 1, poll=preemption_requested,
 ):
     """One pass over ``loader`` with K-fused dispatch + one-chunk upload
     lookahead (device_put is async, so staging chunk N+1 before dispatching N
@@ -108,13 +118,22 @@ def _fused_pass(
     by the train and eval passes; ``step_*(state, batch) -> (state, metrics)``.
     ``accum > 1``: chunks arrive at ``step_many`` as whole accumulation
     cycles (``scan_k`` is a multiple of ``accum``; the ragged tail is padded).
-    Returns ``(state, accumulated_metrics)``."""
+    Returns ``(state, accumulated_metrics, interrupted)``: ``poll`` (the
+    preemption flag on single-host runs — one Event.is_set per dispatch, free
+    next to a device step) is checked at every batch-group boundary and an
+    interrupted pass returns early with the state as of the last *completed*
+    dispatch, for the emergency checkpoint. Multi-host runs pass ``_never``:
+    one host bailing out of the pass mid-epoch while its peers keep issuing
+    step collectives would wedge the pod, so the drain decision moves to the
+    epoch boundary where it can be agreed globally."""
     acc = None
     chunk = []
     staged = None
     for batch_idx, host_batch in enumerate(loader):
         if probe_cb is not None:
             probe_cb(batch_idx, host_batch)
+        if poll():
+            return state, acc, True
         if scan_k <= 1 and accum <= 1:
             state, metrics = step_one(state, ddp.shard(host_batch))
             acc = accumulate_metrics(acc, metrics)
@@ -127,6 +146,8 @@ def _fused_pass(
                 state, metrics = step_many(state, staged)
                 acc = accumulate_metrics(acc, metrics)
             staged = next_staged
+    if poll():
+        return state, acc, True
     if staged is not None:
         state, metrics = step_many(state, staged)
         acc = accumulate_metrics(acc, metrics)
@@ -136,11 +157,13 @@ def _fused_pass(
         tail = _pad_to_cycles(chunk, accum)
         state, metrics = step_many(state, ddp.shard_stacked(stack_batches(tail)))
         acc = accumulate_metrics(acc, metrics)
-        return state, acc
+        return state, acc, poll()
     for host_batch in chunk:  # remainder: single steps, same semantics
+        if poll():
+            return state, acc, True
         state, metrics = step_one(state, ddp.shard(host_batch))
         acc = accumulate_metrics(acc, metrics)
-    return state, acc
+    return state, acc, poll()
 
 
 def run_training_loop(
@@ -157,6 +180,8 @@ def run_training_loop(
     start_epoch: int = 0,
     scan_steps="auto",
     per_replica_log: bool = False,
+    auto_resume: bool = False,
+    keep_last: Optional[int] = None,
     log=print,
 ):
     """Run the full training loop; returns ``(state, history)`` where history
@@ -165,6 +190,15 @@ def run_training_loop(
     ``ddp``: a DistributedDataParallel (or Accelerator-prepared equivalent)
     exposing shard/train_step/eval_step. Loaders yield host ``(x, y, w)``
     batches (ShardedDataLoader for DP; see tpuddp.data.loader).
+
+    Resilience: ``auto_resume=True`` (or ``$TPUDDP_AUTO_RESUME=1``) restores
+    the newest intact checkpoint in ``save_dir`` before training — including a
+    preemption-drain emergency save, whose interrupted epoch is redone. A
+    SIGTERM/SIGINT during training (see tpuddp.resilience.preemption) is
+    polled at batch-group boundaries: the loop writes an emergency checkpoint
+    and raises :class:`TrainingPreempted`, which ``spawn.run_ddp_training``
+    turns into exit code 75. ``keep_last=K`` prunes all but the K newest
+    checkpoints after each save.
     """
     is_main = jax.process_index() == 0
     pbytes = _param_bytes(state.params) if hasattr(state, "params") else None
@@ -201,136 +235,194 @@ def run_training_loop(
                     "the host/device cannot hold it",
                     accum, scan_steps * bnb / 1e6, _STAGE_BYTES_BUDGET // 2**20,
                 )
+    if auto_resume or auto_resume_requested():
+        if save_dir is not None:
+            state, resumed = ckpt.restore_latest(save_dir, state)
+            if resumed > start_epoch:
+                start_epoch = resumed
+                if is_main:
+                    log(f"Auto-resume: continuing from epoch {start_epoch}.")
+        elif is_main:
+            log("Auto-resume requested but no save_dir configured; starting fresh.")
+
     history = []
     metrics_writer = MetricsWriter(save_dir)
     profiling = maybe_start_profiler(save_dir)  # $TPUDDP_PROFILE hook
+
+    multihost = jax.process_count() > 1
+    # single-host: poll the drain flag at every batch-group boundary.
+    # multi-host: never inside a pass — one host returning early while peers
+    # still issue step collectives wedges the pod; drains happen only at the
+    # globally-agreed epoch boundary below.
+    poll = _never if multihost else preemption_requested
+
+    def drain_requested():
+        if not multihost:
+            return preemption_requested()
+        # SIGTERMs land on hosts milliseconds apart; before anyone enters the
+        # save collectives all hosts must agree a drain is on, or the ones
+        # that didn't see the flag yet deadlock the pod. Process 0's flag is
+        # the decision; this broadcast is one tiny per-epoch collective.
+        return bool(col.broadcast_one_to_all(np.asarray(preemption_requested())))
+
+    def emergency_stop(epoch, completed=False):
+        """Preemption drain: one atomic full-state save, then the distinct
+        exit path via TrainingPreempted. ``completed=False`` (the default)
+        marks a mid-train-pass drain — resume redoes ``epoch`` from the saved
+        state. ``completed=True`` is the eval-pass interruption: every
+        optimizer update of ``epoch`` is already applied, so the save counts
+        as end-of-epoch and resume starts at ``epoch + 1`` (re-training it
+        would double-apply the whole epoch); only its eval metrics are lost."""
+        path = None
+        if save_dir is not None:
+            path = ckpt.save_on_main(save_dir, epoch, state, completed=completed)
+            if is_main:
+                log(f"Preempted: emergency checkpoint for epoch {epoch} saved.")
+        raise TrainingPreempted(epoch, path)
 
     if is_main:
         log(
             f"Training on {len(train_loader)} batches, test on {len(test_loader)} batches"
         )
 
-    for epoch in range(start_epoch, num_epochs):
-        t0 = time.perf_counter()
-        if is_main:
-            log(f"Process {jax.process_index()}, Epoch {epoch}")
-        if set_epoch:
-            # Per-epoch reshuffle; without it every epoch replays epoch-0 order
-            # (the pitfall toggle, reference :175-178 / README.md:82-84).
-            train_loader.set_epoch(epoch)
-            test_loader.set_epoch(epoch)
+    try:
+        for epoch in range(start_epoch, num_epochs):
+            faults.maybe_fire("epoch", epoch=epoch)  # $TPUDDP_FAULT chaos hook
+            if drain_requested():
+                emergency_stop(epoch)
+            t0 = time.perf_counter()
             if is_main:
-                log(f"DistributedSampler.set_epoch: {set_epoch}")
+                log(f"Process {jax.process_index()}, Epoch {epoch}")
+            if set_epoch:
+                # Per-epoch reshuffle; without it every epoch replays epoch-0
+                # order (the pitfall toggle, reference :175-178 / README.md:82-84).
+                train_loader.set_epoch(epoch)
+                test_loader.set_epoch(epoch)
+                if is_main:
+                    log(f"DistributedSampler.set_epoch: {set_epoch}")
 
-        if print_rand:
-            log(f"Process {jax.process_index()}, {seeding.rng_probe_string()}")
+            if print_rand:
+                log(f"Process {jax.process_index()}, {seeding.rng_probe_string()}")
 
-        # ---- train pass (hot loop: one jitted step per batch, or per
-        # `scan_steps` batches fused into a single lax.scan dispatch) ----
-        def train_probe(batch_idx, host_batch):
-            if data_probe_every and batch_idx % data_probe_every == 0:
-                probe = getattr(train_loader, "probe_fingerprint", None)
-                if probe is not None:
-                    log(f"TRAIN: Batch {batch_idx}, Data {probe(host_batch[0])}")
+            # ---- train pass (hot loop: one jitted step per batch, or per
+            # `scan_steps` batches fused into a single lax.scan dispatch) ----
+            def train_probe(batch_idx, host_batch):
+                if data_probe_every and batch_idx % data_probe_every == 0:
+                    probe = getattr(train_loader, "probe_fingerprint", None)
+                    if probe is not None:
+                        log(f"TRAIN: Batch {batch_idx}, Data {probe(host_batch[0])}")
 
-        state, train_acc = _fused_pass(
-            ddp, state, train_loader, scan_steps,
-            ddp.train_step, ddp.train_step_many, probe_cb=train_probe,
-            accum=accum,
-        )
-
-        # ---- eval pass (same K-fused dispatch + upload lookahead; without
-        # it the eval epoch is per-batch dispatch-bound). State threads
-        # through untouched. ----
-        _, eval_acc = _fused_pass(
-            ddp, state, test_loader, eval_scan_steps,
-            lambda s, b: (s, ddp.eval_step(s, b)),
-            lambda s, b: (s, ddp.eval_step_many(s, b)),
-        )
-
-        if train_acc is None:
-            raise RuntimeError(
-                "train loader yielded no batches this epoch; check the dataset "
-                "and batch size"
+            state, train_acc, interrupted = _fused_pass(
+                ddp, state, train_loader, scan_steps,
+                ddp.train_step, ddp.train_step_many, probe_cb=train_probe,
+                accum=accum, poll=poll,
             )
+            if interrupted:
+                emergency_stop(epoch)
 
-        # Sync all processes before aggregating (reference :194).
-        col.barrier("tpuddp_epoch", wait_for=(train_acc, eval_acc))
+            # ---- eval pass (same K-fused dispatch + upload lookahead; without
+            # it the eval epoch is per-batch dispatch-bound). State threads
+            # through untouched. ----
+            _, eval_acc, interrupted = _fused_pass(
+                ddp, state, test_loader, eval_scan_steps,
+                lambda s, b: (s, ddp.eval_step(s, b)),
+                lambda s, b: (s, ddp.eval_step_many(s, b)),
+                poll=poll,
+            )
+            if interrupted:
+                emergency_stop(epoch, completed=True)
 
-        if (
-            per_replica_log
-            and eval_acc is not None
-            # per-replica values are host-fetchable only when this process can
-            # address every shard (single-host); multi-host keeps the line out
-            and getattr(train_acc["loss_sum"], "is_fully_addressable", True)
-        ):
-            # pre-aggregation per-device loss lines (reference :186-191);
-            # ONE host fetch for all four arrays, not four round trips
-            tl, tn, el, en = jax.device_get(
-                (
-                    train_acc["loss_sum"],
-                    train_acc["n"],
-                    eval_acc["loss_sum"],
-                    eval_acc["n"],
+            if train_acc is None:
+                raise RuntimeError(
+                    "train loader yielded no batches this epoch; check the "
+                    "dataset and batch size"
                 )
-            )
-            for r in range(tl.size):
+
+            # Sync all processes before aggregating (reference :194).
+            col.barrier("tpuddp_epoch", wait_for=(train_acc, eval_acc))
+
+            if (
+                per_replica_log
+                and eval_acc is not None
+                # per-replica values are host-fetchable only when this process can
+                # address every shard (single-host); multi-host keeps the line out
+                and getattr(train_acc["loss_sum"], "is_fully_addressable", True)
+            ):
+                # pre-aggregation per-device loss lines (reference :186-191);
+                # ONE host fetch for all four arrays, not four round trips
+                tl, tn, el, en = jax.device_get(
+                    (
+                        train_acc["loss_sum"],
+                        train_acc["n"],
+                        eval_acc["loss_sum"],
+                        eval_acc["n"],
+                    )
+                )
+                for r in range(tl.size):
+                    log(
+                        f"Train loss on replica {r}: {tl[r] / max(tn[r], 1):.4f} "
+                        f"based on {int(tn[r])} samples"
+                    )
+                for r in range(el.size):
+                    log(
+                        f"Test loss on replica {r}: {el[r] / max(en[r], 1):.4f} "
+                        f"based on {int(en[r])} samples"
+                    )
+
+            # Aggregate the five scalars (reference :198-204) in ONE fused
+            # cross-device pass + one host fetch.
+            combined = {"train": train_acc}
+            if eval_acc is not None:
+                combined["eval"] = eval_acc
+            sums = finalize_metrics(combined)
+            train_m, eval_m = sums["train"], sums.get("eval")
+            train_loss = train_m["loss_sum"] / max(train_m["n"], 1.0)
+            if eval_m is not None:
+                test_loss = eval_m["loss_sum"] / max(eval_m["n"], 1.0)
+                test_accuracy = 100.0 * eval_m["correct"] / max(eval_m["n"], 1.0)
+            else:  # empty test loader: report train-only metrics
+                eval_m = {"n": 0.0}
+                test_loss = float("nan")
+                test_accuracy = float("nan")
+
+            epoch_time = time.perf_counter() - t0
+            record = {
+                "epoch": epoch,
+                "train_loss": train_loss,
+                "test_loss": test_loss,
+                "test_accuracy": test_accuracy,
+                "train_samples": train_m["n"],
+                "test_samples": eval_m["n"],
+                "epoch_time_s": epoch_time,
+                "samples_per_sec": (train_m["n"] + eval_m["n"]) / max(epoch_time, 1e-9),
+            }
+            history.append(record)
+            metrics_writer.write(record)
+            check_finite(train_loss, "train loss")  # $TPUDDP_DEBUG_NANS guard
+
+            if profiling and epoch == start_epoch:
+                stop_profiler()  # trace the first epoch only
+                profiling = False
+
+            if is_main:
+                # Exact reference log format (:209-215).
                 log(
-                    f"Train loss on replica {r}: {tl[r] / max(tn[r], 1):.4f} "
-                    f"based on {int(tn[r])} samples"
-                )
-            for r in range(el.size):
-                log(
-                    f"Test loss on replica {r}: {el[r] / max(en[r], 1):.4f} "
-                    f"based on {int(en[r])} samples"
+                    f"Epoch {epoch + 1}/{num_epochs}, "
+                    f"Train Loss: {train_loss:.4f}, "
+                    f"Test Loss: {test_loss:.4f}, "
+                    f"Test Accuracy: {test_accuracy:.2f}%"
                 )
 
-        # Aggregate the five scalars (reference :198-204) in ONE fused
-        # cross-device pass + one host fetch.
-        combined = {"train": train_acc}
-        if eval_acc is not None:
-            combined["eval"] = eval_acc
-        sums = finalize_metrics(combined)
-        train_m, eval_m = sums["train"], sums.get("eval")
-        train_loss = train_m["loss_sum"] / max(train_m["n"], 1.0)
-        if eval_m is not None:
-            test_loss = eval_m["loss_sum"] / max(eval_m["n"], 1.0)
-            test_accuracy = 100.0 * eval_m["correct"] / max(eval_m["n"], 1.0)
-        else:  # empty test loader: report train-only metrics
-            eval_m = {"n": 0.0}
-            test_loss = float("nan")
-            test_accuracy = float("nan")
-
-        epoch_time = time.perf_counter() - t0
-        record = {
-            "epoch": epoch,
-            "train_loss": train_loss,
-            "test_loss": test_loss,
-            "test_accuracy": test_accuracy,
-            "train_samples": train_m["n"],
-            "test_samples": eval_m["n"],
-            "epoch_time_s": epoch_time,
-            "samples_per_sec": (train_m["n"] + eval_m["n"]) / max(epoch_time, 1e-9),
-        }
-        history.append(record)
-        metrics_writer.write(record)
-        check_finite(train_loss, "train loss")  # $TPUDDP_DEBUG_NANS guard
-
-        if profiling and epoch == start_epoch:
-            stop_profiler()  # trace the first epoch only
-            profiling = False
-
-        if is_main:
-            # Exact reference log format (:209-215).
-            log(
-                f"Epoch {epoch + 1}/{num_epochs}, "
-                f"Train Loss: {train_loss:.4f}, "
-                f"Test Loss: {test_loss:.4f}, "
-                f"Test Accuracy: {test_accuracy:.2f}%"
-            )
-
-        if save_dir is not None and epoch % checkpoint_epoch == 0:
-            ckpt.save_on_main(save_dir, epoch, state)
+            if save_dir is not None and epoch % checkpoint_epoch == 0:
+                ckpt.save_on_main(
+                    save_dir, epoch, state, keep_last=keep_last
+                )
+    finally:
+        # An exception mid-epoch (preemption, NaN guard, a worker crash) must
+        # not lose the trace — it is the post-mortem artifact — nor leave the
+        # JSONL metrics record unflushed/truncated.
+        stop_profiler()
+        metrics_writer.close()
 
     if is_main:
         log(f"Finished Training on process {jax.process_index()}.")

@@ -40,6 +40,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from tpuddp import optim as _optim
 from tpuddp.nn.core import Context
 from tpuddp.parallel import collectives as col
+from tpuddp.utils.compat import shard_map
 from tpuddp.parallel.mesh import DATA_AXIS, data_sharded, replicated
 from tpuddp.seeding import fold_in_axis_index
 from tpuddp.training.train_state import TrainState
@@ -363,7 +364,7 @@ def build_train_step(
             model, criterion, optimizer, DATA_AXIS, sync_buffers,
             clip_grad_norm, augment, remat, wus_spec=wus_spec,
         )
-        fn = jax.shard_map(
+        fn = shard_map(
             core,
             mesh=mesh,
             in_specs=(st_spec, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
@@ -536,7 +537,7 @@ def build_train_scan_step(
 
     if mode == "shard_map":
         st_spec = state_spec if state_spec is not None else P()
-        fn = jax.shard_map(
+        fn = shard_map(
             multi,
             mesh=mesh,
             in_specs=(st_spec, in_batch, in_batch, in_batch),
@@ -584,7 +585,7 @@ def build_eval_step(
     match)."""
     if mode == "shard_map":
         core = _make_eval_core(model, criterion, DATA_AXIS, transform)
-        fn = jax.shard_map(
+        fn = shard_map(
             core,
             mesh=mesh,
             in_specs=(
@@ -641,7 +642,7 @@ def build_eval_scan_step(
 
     if mode == "shard_map":
         in_batch = P(None, DATA_AXIS)
-        fn = jax.shard_map(
+        fn = shard_map(
             multi,
             mesh=mesh,
             in_specs=(

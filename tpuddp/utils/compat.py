@@ -1,0 +1,43 @@
+"""JAX version-compatibility shims.
+
+The framework targets the current ``jax.shard_map`` API; older runtimes (< 0.6)
+only ship ``jax.experimental.shard_map.shard_map`` with the pre-rename
+``check_rep`` keyword (renamed ``check_vma`` when the API stabilized). One
+resolution point here keeps every call site on the modern spelling — the
+robustness analog of stubbing a missing dep instead of crashing at import.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as _P
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+        if in_specs is None:
+            # modern API: None = every input replicated; the experimental one
+            # wants PartitionSpec pytrees (P() is the all-replicated prefix)
+            in_specs = _P()
+        return _experimental_shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_vma,
+            **kwargs,
+        )
+
+
+if hasattr(lax, "axis_size"):
+    axis_size = lax.axis_size
+else:
+
+    def axis_size(axis_name):
+        # pre-axis_size spelling: a psum of 1 over the axis; XLA folds it to a
+        # compile-time constant, so this costs nothing at runtime
+        return lax.psum(1, axis_name)

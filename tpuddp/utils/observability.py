@@ -63,10 +63,16 @@ def check_finite(value: float, what: str) -> None:
 
 
 class MetricsWriter:
-    """Process-0 JSONL metrics sink (``history.jsonl`` in the run dir)."""
+    """Process-0 JSONL metrics sink (``history.jsonl`` in the run dir).
+
+    Holds one append handle (opened lazily at the first record) and flushes
+    after every line, so the file always ends on a whole JSON record — a crash
+    or preemption mid-epoch must not truncate the machine-readable history.
+    The epoch driver calls :meth:`close` from its ``finally`` block."""
 
     def __init__(self, save_dir: Optional[str], filename: str = "history.jsonl"):
         self.path = None
+        self._f = None
         if save_dir is not None and jax.process_index() == 0:
             os.makedirs(save_dir, exist_ok=True)
             self.path = os.path.join(save_dir, filename)
@@ -74,5 +80,22 @@ class MetricsWriter:
     def write(self, record: dict) -> None:
         if self.path is None:
             return
-        with open(self.path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        if self._f is None:
+            self._f = open(self.path, "a")
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __del__(self):  # backstop for callers that never reach close()
+        try:
+            self.close()
+        except Exception:
+            pass

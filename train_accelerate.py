@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import time
 
 import jax
@@ -24,6 +25,13 @@ import numpy as np
 from tpuddp import config as cfg_lib
 from tpuddp import nn, optim
 from tpuddp.accelerate import Accelerator
+from tpuddp.resilience.preemption import (
+    EXIT_PREEMPTED,
+    TrainingPreempted,
+    auto_resume_requested,
+    install_preemption_handler,
+    preemption_requested,
+)
 from tpuddp.data import (
     DataLoader,
     compute_dtype_for,
@@ -173,8 +181,27 @@ def run_training_loop(
 
     metrics_writer = MetricsWriter(save_dir)
     profiling = maybe_start_profiler(save_dir)
+    def drain(last_completed_epoch):
+        """Preemption drain (SIGTERM/SIGINT seen at a managed-loop boundary):
+        publish the lossless state of the last fully-trained epoch so a
+        requeued ``training.resume``/auto-resume run continues after it, then
+        raise for the exit-75 conversion in ``__main__``."""
+        if last_completed_epoch >= 0:
+            accelerator.wait_for_everyone()
+            accelerator.save_state(
+                model, optimizer, save_dir, epoch=last_completed_epoch
+            )
+            if accelerator.is_local_main_process:
+                print(
+                    f"Preempted: emergency state for epoch "
+                    f"{last_completed_epoch} saved."
+                )
+        raise TrainingPreempted(last_completed_epoch + 1)
+
     try:
         for epoch in range(start_epoch, num_epochs):
+            if preemption_requested():
+                drain(epoch - 1)
             train_loader.set_epoch(epoch)
             epoch_t0 = time.perf_counter()
             train_loss, train_samples = train(
@@ -186,6 +213,10 @@ def run_training_loop(
                 augment,
                 deferred=deferred_metrics,
             )
+            if preemption_requested():
+                # the train pass completed, so every update of this epoch is
+                # applied — save it as done and lose only the eval metrics
+                drain(epoch)
             test_loss, test_accuracy, test_samples = evaluate(
                 model,
                 test_loader,
@@ -246,6 +277,9 @@ def run_training_loop(
 
 def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
     training = training or cfg_lib.TRAINING_DEFAULTS
+    # SIGTERM/SIGINT -> drain flag (polled at managed-loop boundaries);
+    # main-thread only, a no-op under threaded test runners
+    install_preemption_handler()
     # Topology discovery happens inside the Accelerator (reference :115);
     # num_chips honors a configured sub-world on multi-chip hosts.
     # fuse_steps batches K optimizer.step()s into one scan dispatch; it only
@@ -322,7 +356,13 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
     # _ensure_init only reads shape/dtype, so no batch assembly, no prefetch
     # thread, and only the transform's tiny dispatch runs).
     start_epoch = 0
-    if training.get("resume"):
+    resume = (
+        training.get("resume")
+        or training.get("auto_resume")
+        # the scheduler-requeue path: same command, env flag set (exit-75 contract)
+        or auto_resume_requested()
+    )
+    if resume:
         img0, _label0 = train_loader.dataset[0]
         x0 = eval_transform(jnp.asarray(np.asarray(img0)[None]))
         model(x0)
@@ -392,4 +432,12 @@ if __name__ == "__main__":
 
         maybe_reexec_for_world(world_size, cfg_lib.device_from(settings))
 
-    basic_accelerate_training(out_dir, training, num_chips=world_size)
+    try:
+        basic_accelerate_training(out_dir, training, num_chips=world_size)
+    except TrainingPreempted as e:
+        # the exit-code contract (README "Fault tolerance"): 75 = EX_TEMPFAIL,
+        # drained after SIGTERM — requeue the same command to auto-resume
+        logging.getLogger("tpuddp").warning(
+            "%s; exiting %d (requeue+resume)", e, EXIT_PREEMPTED
+        )
+        raise SystemExit(EXIT_PREEMPTED)

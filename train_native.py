@@ -159,6 +159,13 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         start_epoch=start_epoch,
         scan_steps=training.get("scan_steps", "auto"),
         per_replica_log=True,  # reference's per-device loss lines (:186-191)
+        # resilience knobs: auto_resume restores the newest INTACT checkpoint
+        # (also forced by $TPUDDP_AUTO_RESUME=1, the scheduler-requeue path);
+        # keep_last bounds checkpoint disk on long runs
+        auto_resume=bool(training.get("auto_resume")),
+        keep_last=(
+            int(training["keep_last"]) if training.get("keep_last") else None
+        ),
     )
 
 
