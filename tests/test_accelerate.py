@@ -936,3 +936,37 @@ def test_auto_fuse_respects_staging_budget():
     assert _resolve_auto_fuse(None, batch_nbytes=38_535_168) == 6
     assert _resolve_auto_fuse(None, batch_nbytes=400_000) == 32
     assert _resolve_auto_fuse(None, batch_nbytes=10**10) == 1
+
+
+def test_fused_evaluator_rederives_depth_on_ragged_streams(mesh):
+    """ISSUE 2 satellite (advisor r5): the auto fuse depth is cached per
+    batch SHAPE, not pinned for the evaluator's lifetime — a depth resolved
+    from an early small batch must not let a later large batch stage
+    depth x batch bytes past the ~256 MB staging budget."""
+    from tpuddp.accelerate import FusedEvaluator, _resolve_auto_fuse
+
+    acc = Accelerator(mesh=mesh, seed=0)
+    model = acc.prepare(ToyMLP(hidden=(16,)))
+    model.eval()
+    criterion = nn.CrossEntropyLoss()
+    ev = FusedEvaluator(model, criterion)  # fuse_steps=None -> auto
+
+    small = np.zeros((4, 8, 8, 3), np.float32)
+    y4 = np.zeros(4, np.int32)
+    model(small)  # materialize params so the depth resolution caches
+    ev.add(small, y4)
+    assert ev._resolve_fuse() == 32  # tiny batches: the flat auto cap
+    ev.finalize()  # drain the small-shape stream
+
+    # a late LARGE batch (224x224 f32, ~77 MB logical — broadcast view, no
+    # real allocation): the shape change must re-derive and re-cap the depth
+    big = np.broadcast_to(np.zeros((1, 1, 1, 1), np.float32), (128, 224, 224, 3))
+    ev.add(big, np.zeros(128, np.int32))
+    depth = ev._resolve_fuse()
+    assert depth == _resolve_auto_fuse(model._params, big.nbytes) < 32
+    ev._queue.clear()  # the broadcast stand-in is never evaluated
+
+    # and back to small: re-derived again, not stuck on the big-batch cap
+    ev.add(small, y4)
+    assert ev._resolve_fuse() == 32
+    ev._queue.clear()

@@ -1,0 +1,420 @@
+"""Gradient-communication hooks (tpuddp/parallel/comm.py) — the tpuddp
+rebuild of torch DDP's bucketed allreduce + comm hooks (SURVEY.md §2b,
+``default_hooks.bf16_compress_hook`` et al.).
+
+Pinned contracts:
+
+- bucket assembly: deterministic whole-leaf packing, cap respected, oversized
+  leaves isolated, padding absorbed by the tail, exact cover of the padded
+  flat vector;
+- the wire really carries bf16: the compiled HLO of the explicit step holds a
+  bf16 all-reduce (or bf16 reduce-scatter under weight_update_sharding);
+- numerics: bf16_ef training tracks the fp32 path's loss within tolerance
+  over N steps on the 8-device virtual world, in every mode the knob reaches
+  (explicit shard_map / auto, scan-fused, grad accumulation, managed);
+- the comm-bytes counter shows the >= 45% gradient-byte reduction the ISSUE
+  acceptance demands;
+- the bf16_ef error-feedback residual is training state: it must be nonzero
+  once training has run, and must checkpoint-round-trip losslessly on both
+  the native (training/checkpoint.py) and managed (save_state/load_state)
+  paths.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuddp import nn, optim
+from tpuddp.data import SyntheticClassification
+from tpuddp.models import ToyMLP
+from tpuddp.parallel import comm as comm_lib
+from tpuddp.parallel import make_mesh
+from tpuddp.parallel.ddp import DistributedDataParallel
+from tpuddp.training import checkpoint as ckpt
+from tpuddp.training.step import stack_batches
+
+KEY = jax.random.key(0)
+MB = 1024 * 1024
+
+
+def cap_mb(elems: int) -> float:
+    """bucket_cap_mb holding exactly ``elems`` f32 elements."""
+    return elems * 4 / MB
+
+
+def make_batch(n=64, seed=5, shape=(8, 8, 3)):
+    ds = SyntheticClassification(n=n, shape=shape, seed=seed)
+    x, y = ds.get_batch(np.arange(n))
+    return x, y, np.ones(n, np.float32)
+
+
+def build(mesh, hook, mode="shard_map", wus=False, accum=1, cap=None):
+    return DistributedDataParallel(
+        ToyMLP(hidden=(16,)),
+        optim.Adam(1e-2),
+        nn.CrossEntropyLoss(),
+        mesh=mesh,
+        mode=mode,
+        comm_hook=hook,
+        weight_update_sharding=wus,
+        grad_accumulation=accum,
+        **({"bucket_cap_mb": cap} if cap is not None else {}),
+    )
+
+
+# ---------------------------------------------------------------- buckets --
+
+
+def test_buckets_cover_padded_vector_exactly():
+    # 18 raw elements padded to a world multiple (24): the tail bucket
+    # absorbs the padding so the buckets tile [0, total) with no gap
+    b = comm_lib.make_buckets((6, 6, 6), total=24, bucket_cap_mb=cap_mb(16))
+    assert b == ((0, 12), (12, 24))
+    assert b[0][0] == 0 and b[-1][1] == 24
+    for (s0, e0), (s1, _) in zip(b, b[1:]):
+        assert e0 == s1 and s0 < e0
+
+
+def test_bucket_cap_respected_on_whole_leaf_boundaries():
+    sizes = (4, 4, 4, 4, 4)
+    b = comm_lib.make_buckets(sizes, total=24, bucket_cap_mb=cap_mb(10))
+    # greedy whole-leaf packing: 4+4 <= 10 < 4+4+4 -> buckets of two leaves
+    assert b == ((0, 8), (8, 16), (16, 24))
+    boundaries = set(np.cumsum((0,) + sizes)) | {24}
+    for s, e in b:
+        assert s in boundaries  # never splits a leaf
+
+
+def test_oversized_leaf_gets_its_own_bucket():
+    # torch DDP's rule: a tensor larger than the cap is never split
+    b = comm_lib.make_buckets((100, 4), total=104, bucket_cap_mb=cap_mb(16))
+    assert b == ((0, 100), (100, 104))
+
+
+def test_buckets_deterministic_and_odd_remainders():
+    sizes = (7, 3, 11, 1, 5)  # ragged odd sizes, total padded to 32
+    a = comm_lib.make_buckets(sizes, 32, bucket_cap_mb=cap_mb(12))
+    assert a == comm_lib.make_buckets(sizes, 32, bucket_cap_mb=cap_mb(12))
+    assert a[0][0] == 0 and a[-1][1] == 32
+    covered = sum(e - s for s, e in a)
+    assert covered == 32
+    # every bucket holds at least one whole leaf and respects the cap unless
+    # it is a single oversized leaf or the padding-absorbing tail
+    edges = list(np.cumsum(sizes))
+    for s, e in a[:-1]:
+        n_leaves = sum(1 for c in edges if s < c <= e)
+        assert n_leaves >= 1
+        assert (e - s) <= 12 or n_leaves == 1
+
+
+def test_bucket_cap_validation(cpu_devices):
+    with pytest.raises(ValueError, match="bucket_cap_mb"):
+        comm_lib.make_buckets((4,), 8, bucket_cap_mb=0)
+    with pytest.raises(ValueError, match="comm_hook"):
+        comm_lib.validate_hook("fp8")
+    mesh = make_mesh(cpu_devices)
+    with pytest.raises(ValueError, match="comm_hook"):
+        build(mesh, "int8")
+    with pytest.raises(ValueError, match="bucket_cap_mb"):
+        build(mesh, "bf16", cap=-1.0)
+    # both API levels share the knob contract
+    from tpuddp.accelerate import Accelerator
+
+    with pytest.raises(ValueError, match="bucket_cap_mb"):
+        Accelerator(mesh=mesh, bucket_cap_mb=0)
+    with pytest.raises(ValueError, match="comm_hook"):
+        Accelerator(mesh=mesh, comm_hook="int8")
+
+
+def test_make_grad_comm_plan():
+    params = {"w": jnp.zeros((13, 7)), "b": jnp.zeros((7,))}
+    assert comm_lib.make_grad_comm(params, 8, "none") is None
+    plan = comm_lib.make_grad_comm(params, 8, "bf16_ef", bucket_cap_mb=cap_mb(64))
+    assert plan.compressed and plan.needs_residual
+    assert plan.buckets[0][0] == 0 and plan.buckets[-1][1] == plan.spec.total
+    # residual layouts: per-replica (world * total) vs replicated (total)
+    assert plan.init_residual(per_replica=True).shape == (8 * plan.spec.total,)
+    assert plan.init_residual(per_replica=False).shape == (plan.spec.total,)
+    bf16 = comm_lib.make_grad_comm(params, 8, "bf16")
+    assert bf16.compressed and not bf16.needs_residual
+    assert bf16.init_residual(per_replica=True) is None
+
+
+# ----------------------------------------------------------- wire accounting
+
+
+def test_comm_bytes_reduction_at_least_45_percent():
+    # any realistic f32 parameter pytree works; sizes chosen so the
+    # world-multiple padding is negligible against the leaf sum
+    p = {"w1": jnp.zeros((192, 64)), "b1": jnp.zeros((64,)),
+         "w2": jnp.zeros((64, 10)), "b2": jnp.zeros((10,))}
+    base = comm_lib.comm_bytes_for_hook(p, 8, "none")
+    for hook in ("bf16", "bf16_ef"):
+        comp = comm_lib.comm_bytes_for_hook(p, 8, hook)
+        assert 1 - comp / base >= 0.45, (hook, comp, base)
+    wbase = comm_lib.comm_bytes_for_hook(p, 8, "none", wus=True)
+    wcomp = comm_lib.comm_bytes_for_hook(p, 8, "bf16_ef", wus=True)
+    assert 1 - wcomp / wbase >= 0.45
+
+
+def test_ddp_counter_property(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    ddp = build(mesh, "bf16_ef")
+    assert ddp.grad_comm_bytes_per_step is None  # pre-init: no plan yet
+    ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    comp = ddp.grad_comm_bytes_per_step
+    base = build(mesh, "none")
+    base.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    assert 1 - comp / base.grad_comm_bytes_per_step >= 0.45
+
+
+def test_auto_mode_counter_reports_f32_wire(cpu_devices):
+    """mode="auto": XLA inserts the psum over f32 values and the hook only
+    emulates the quantization — the counter must report the f32 payload, not
+    a byte cut that never reached the wire."""
+    mesh = make_mesh(cpu_devices)
+    comp = build(mesh, "bf16_ef", mode="auto")
+    comp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    base = build(mesh, "none", mode="auto")
+    base.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    assert comp.grad_comm_bytes_per_step == base.grad_comm_bytes_per_step
+
+
+def test_comm_bytes_counter_class():
+    from tpuddp.utils.observability import CommBytesCounter
+
+    c = CommBytesCounter(1000)
+    c.add_updates(3)
+    c.add_updates(2)
+    assert c.total_bytes == 5000
+    snap = c.snapshot(epoch_updates=2)
+    assert snap["grad_comm_bytes_per_update"] == 1000
+    assert snap["grad_comm_bytes_total"] == 5000
+    assert snap["grad_comm_bytes_epoch"] == 2000
+    # inert counter (pre-init ddp / facade without the attribute): epoch
+    # records must stay unchanged
+    inert = CommBytesCounter(None)
+    inert.add_updates(7)
+    assert inert.total_bytes is None and inert.snapshot(7) == {}
+
+
+# ------------------------------------------------------------- wire dtype --
+
+
+def _collective_window(ddp, st, batch, op):
+    """The text window of the first ``op`` in the LOWERED step program.
+
+    Lowered (StableHLO), not backend-compiled: the byte-reduction contract is
+    "the program tpuddp emits requests the gradient collective in the wire
+    dtype". Whether the wire then honors it is the backend's legalization —
+    TPU ICI carries bf16 collectives natively, while this CPU test world
+    upcasts them to f32 at compile time (the quantization numerics survive
+    either way; that is what the loss-parity tests pin)."""
+    fn = lambda s, b: ddp.train_step(s, b)  # noqa: E731
+    txt = jax.jit(fn).lower(st, batch).as_text()
+    i = txt.find(op)
+    assert i >= 0, f"no {op} in the lowered step program"
+    return txt[i : i + 900]
+
+
+def test_lowered_step_requests_bf16_allreduce(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    for hook, want in (("bf16", True), ("none", False)):
+        ddp = build(mesh, hook)
+        st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        window = _collective_window(
+            ddp, st, ddp.shard((x, y, w)), "stablehlo.all_reduce"
+        )
+        assert ("xbf16>" in window) == want, (hook, window[:200])
+
+
+def test_lowered_wus_step_requests_bf16_reduce_scatter(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    ddp = build(mesh, "bf16_ef", wus=True)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    window = _collective_window(
+        ddp, st, ddp.shard((x, y, w)), "stablehlo.reduce_scatter"
+    )
+    assert "xbf16>" in window
+
+
+# --------------------------------------------------------------- numerics --
+
+
+def _run_steps(ddp, steps=8, seed=5):
+    x, y, w = make_batch(seed=seed)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    m = None
+    for _ in range(steps):
+        st, m = ddp.train_step(st, ddp.shard((x, y, w)))
+    loss = float(np.sum(np.asarray(m["loss_sum"]))) / float(
+        np.sum(np.asarray(m["n"]))
+    )
+    return st, loss
+
+
+@pytest.mark.parametrize("mode", ["shard_map", "auto"])
+@pytest.mark.parametrize("hook", ["bf16", "bf16_ef"])
+def test_compressed_training_tracks_f32_loss(cpu_devices, mode, hook):
+    mesh = make_mesh(cpu_devices)
+    _, base = _run_steps(build(mesh, "none", mode=mode))
+    st, comp = _run_steps(build(mesh, hook, mode=mode))
+    assert np.isfinite(comp)
+    assert abs(comp - base) <= max(0.05, 0.02 * abs(base)), (hook, mode)
+    if hook == "bf16_ef":
+        res = np.asarray(st.comm_state)
+        assert res.dtype == np.float32 and np.any(res != 0)
+    else:
+        assert st.comm_state is None
+
+
+def test_bf16_ef_composes_with_wus(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    _, base = _run_steps(build(mesh, "none", wus=True))
+    st, comp = _run_steps(build(mesh, "bf16_ef", wus=True))
+    assert abs(comp - base) <= max(0.05, 0.02 * abs(base))
+    assert np.any(np.asarray(st.comm_state) != 0)
+
+
+def test_bf16_ef_scan_fused_and_accumulation(cpu_devices):
+    """The residual threads through the lax.scan carry: K fused steps with
+    grad_accumulation=2 stay on the fp32 trajectory and update the
+    residual."""
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    k = 4  # 2 optimizer updates per dispatch at accum=2
+
+    def run(hook):
+        ddp = build(mesh, hook, accum=2)
+        st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        stacked = ddp.shard_stacked(stack_batches([(x, y, w)] * k))
+        m = None
+        for _ in range(4):
+            st, m = ddp.train_step_many(st, stacked)
+        loss = float(np.sum(np.asarray(m["loss_sum"]))) / float(
+            np.sum(np.asarray(m["n"]))
+        )
+        return st, loss
+
+    _, base = run("none")
+    st, comp = run("bf16_ef")
+    assert np.isfinite(comp)
+    assert abs(comp - base) <= max(0.05, 0.02 * abs(base))
+    assert np.any(np.asarray(st.comm_state) != 0)
+
+
+def test_local_quantize_error_feedback_conserves():
+    """The managed emulation's invariant: quantized + new_residual == grads +
+    old_residual exactly (both sides are the same f32 subtraction)."""
+    g = {"w": jnp.asarray(np.random.RandomState(0).randn(32).astype(np.float32))}
+    r = comm_lib.init_residual_tree(g)
+    q, r1 = comm_lib.local_quantize(g, r, "bf16_ef")
+    np.testing.assert_array_equal(
+        np.asarray(q["w"] + r1["w"]), np.asarray(g["w"] + r["w"])
+    )
+    # and the quantized value really is bf16-representable
+    qw = np.asarray(q["w"])
+    np.testing.assert_array_equal(
+        qw, qw.astype(jnp.bfloat16).astype(np.float32)
+    )
+    # hook "none" is the identity; "bf16" carries no residual
+    g2, r2 = comm_lib.local_quantize(g, None, "none")
+    assert g2 is g and r2 is None
+    q3, r3 = comm_lib.local_quantize(g, None, "bf16")
+    assert r3 is None and np.any(np.asarray(q3["w"]) != np.asarray(g["w"]))
+
+
+# ------------------------------------------------------------ checkpoints --
+
+
+def test_native_residual_checkpoint_roundtrip(cpu_devices, tmp_path):
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    ddp = build(mesh, "bf16_ef")
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    for _ in range(3):
+        st, _ = ddp.train_step(st, ddp.shard((x, y, w)))
+    res = np.asarray(st.comm_state)
+    assert np.any(res != 0)
+    path = ckpt.save(str(tmp_path / "ckpt_1.npz"), st)
+    # a fresh same-shape state is the load template (the loop's resume path)
+    ddp2 = build(mesh, "bf16_ef")
+    st2 = ddp2.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    restored = ckpt.load(path, st2)
+    np.testing.assert_array_equal(np.asarray(restored.comm_state), res)
+    # and the restored state trains on (placement re-established by the jit)
+    st3, m = ddp2.train_step(restored, ddp2.shard((x, y, w)))
+    assert np.isfinite(float(np.sum(np.asarray(m["loss_sum"]))))
+    assert np.any(np.asarray(st3.comm_state) != res)
+
+
+def test_hookless_checkpoint_structure_unchanged(cpu_devices, tmp_path):
+    """comm_state=None must not appear as a checkpoint leaf: hook-less
+    checkpoints keep their historical structure (old checkpoints stay
+    loadable, new hook-less ones stay loadable by old code)."""
+    mesh = make_mesh(cpu_devices)
+    ddp = build(mesh, "none")
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    path = ckpt.save(str(tmp_path / "ckpt_1.npz"), st)
+    with np.load(path) as data:
+        assert not any("comm_state" in k for k in data.files)
+
+
+def test_pre_hook_checkpoint_loads_into_ef_template(cpu_devices, tmp_path):
+    """Turning comm_hook="bf16_ef" ON over checkpoints from a hook-less run
+    must resume, not crash: the missing residual leaf keeps the template's
+    zero initialization (exactly a fresh compressed run's starting state)."""
+    mesh = make_mesh(cpu_devices)
+    ddp = build(mesh, "none")
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    path = ckpt.save(str(tmp_path / "ckpt_1.npz"), st)  # no comm_state leaf
+    ef = build(mesh, "bf16_ef")
+    st2 = ef.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    restored = ckpt.load(path, st2)
+    assert not np.any(np.asarray(restored.comm_state))
+    x, y, w = make_batch()
+    st3, m = ef.train_step(restored, ef.shard((x, y, w)))
+    assert np.isfinite(float(np.sum(np.asarray(m["loss_sum"]))))
+    assert np.any(np.asarray(st3.comm_state) != 0)
+
+
+def test_managed_residual_roundtrip(cpu_devices, tmp_path):
+    from tpuddp.accelerate import Accelerator
+
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch(n=32)
+    criterion = nn.CrossEntropyLoss()
+
+    def steps(acc, model, opt, n):
+        last = None
+        for _ in range(n):
+            opt.zero_grad()
+            loss = criterion(model(x), y, w)
+            acc.backward(loss)
+            opt.step()
+            last = loss.item()
+        return last
+
+    acc = Accelerator(mesh=mesh, seed=3, comm_hook="bf16_ef")
+    model, opt = acc.prepare(ToyMLP(hidden=(16,)), optim.Adam(1e-2))
+    steps(acc, model, opt, 3)
+    assert opt._comm_state is not None
+    res = jax.tree_util.tree_map(np.asarray, opt._comm_state)
+    assert any(np.any(l != 0) for l in jax.tree_util.tree_leaves(res))
+    assert opt.grad_comm_bytes_per_step is not None
+    acc.save_state(model, opt, str(tmp_path), epoch=1)
+    cont = steps(acc, model, opt, 2)  # the run we must be able to reproduce
+
+    acc2 = Accelerator(mesh=mesh, seed=3, comm_hook="bf16_ef")
+    model2, opt2 = acc2.prepare(ToyMLP(hidden=(16,)), optim.Adam(1e-2))
+    model2(x[:1])  # materialize structure to load into
+    assert acc2.load_state(model2, opt2, str(tmp_path)) == 2
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        opt2._comm_state, res,
+    )
+    resumed = steps(acc2, model2, opt2, 2)
+    np.testing.assert_allclose(resumed, cont, rtol=0, atol=1e-6)

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Run the FULL test gate — both tiers in one explicit invocation.
+
+``pytest.ini`` sets ``addopts = -m "not slow"``, so a bare ``pytest`` run is
+the fast tier-1 gate only: the subprocess/CLI end-to-end runs, the multichip
+dryrun, the big pretrained-import donors, the fuzz sweeps, and the chaos
+suite (chaos tests are also slow-marked) all silently fall out of any default
+invocation. This runner makes "run everything" a command instead of a marker
+expression someone must remember: it selects ``-m "slow or not slow"`` —
+every collected test, both tiers — and inherits pytest's exit-code contract
+(non-zero on failures, 4/5 if the expression ever selects nothing, i.e. the
+two-tier contract itself drifted).
+
+Usage: python tools/run_full_gate.py [extra pytest args]
+
+The two-tier contract is documented in README "Testing"; the chaos tier can
+still be run alone via tools/run_chaos.py.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # the full gate never needs a real TPU
+    cmd = [
+        sys.executable, "-m", "pytest", "tests", "-q",
+        "-m", "slow or not slow",
+        "-p", "no:cacheprovider",
+        *(argv if argv is not None else sys.argv[1:]),
+    ]
+    return subprocess.call(cmd, cwd=REPO, env=env)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,7 @@ from tpuddp import seeding
 from tpuddp.data.loader import DataLoader, ShardedDataLoader
 from tpuddp.nn.core import Context, Module
 from tpuddp.parallel import collectives as col
+from tpuddp.parallel import comm as comm_lib
 from tpuddp.parallel.mesh import data_mesh, replicate, shard_batch
 from tpuddp.training import checkpoint as ckpt
 
@@ -247,15 +248,23 @@ class FusedEvaluator:
         self._queue = []
         self._stats = None
         self._progs = {}
+        # auto-depth cache, keyed by the queued batch's shape_key: on ragged
+        # streams the depth is RE-derived (and re-capped by the staging
+        # budget) whenever the batch shape changes — a depth pinned by an
+        # early small batch must not let a later large batch stage
+        # depth x batch bytes past the ~256 MB budget
+        self._fuse_cache = None  # (shape_key, resolved depth)
 
     def _resolve_fuse(self) -> int:
         if self.fuse_steps is not None:
             return self.fuse_steps
         batch_nbytes = None
+        shape_key = None
         if self._queue:
             # .nbytes is metadata on both numpy and jax arrays — never
             # np.asarray a queued x here, it may be a staged device array
             # and the conversion would force a host transfer
+            shape_key = self._queue[0][0]
             batch_nbytes = getattr(self._queue[0][1], "nbytes", None)
         params = self.model._params
         if params is None or params is _LOST_TO_FAILED_FLUSH or not self._queue:
@@ -263,8 +272,11 @@ class FusedEvaluator:
             # batch is in hand (an empty-queue probe would pin the uncapped
             # depth and bypass the staging budget for the evaluator's life)
             return _resolve_auto_fuse(None, batch_nbytes)
-        self.fuse_steps = _resolve_auto_fuse(params, batch_nbytes)
-        return self.fuse_steps
+        if self._fuse_cache is None or self._fuse_cache[0] != shape_key:
+            self._fuse_cache = (
+                shape_key, _resolve_auto_fuse(params, batch_nbytes)
+            )
+        return self._fuse_cache[1]
 
     def add(self, x, y, w=None):
         if w is None:
@@ -694,10 +706,15 @@ class PreparedModel:
         self._pending = None
         lazy_loss._value = loss
 
+    def _comm_hook_name(self) -> str:
+        return getattr(self.accelerator, "comm_hook", "none")
+
     def _get_fused_step(self, criterion, optimizer):
         key = (criterion, optimizer)
         if self._fused_step is None or self._fused_step[0] != key:
-            def fused(params, mstate, opt_state, base_rng, step_idx, x, y, w):
+            hook = self._comm_hook_name()
+
+            def fused(params, mstate, opt_state, comm_state, base_rng, step_idx, x, y, w):
                 rng = jax.random.fold_in(base_rng, step_idx)
 
                 def loss_fn(p):
@@ -712,13 +729,20 @@ class PreparedModel:
                 (loss, new_mstate), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
                 )(params)
+                # comm hook (managed emulation, parallel/comm.py): quantize
+                # the aggregated gradient through the wire dtype with error
+                # feedback BEFORE the clip, matching the native step's
+                # reduce-then-clip order
+                grads, comm_state = comm_lib.local_quantize(
+                    grads, comm_state, hook
+                )
                 grads = self._maybe_clip(grads)
                 new_params, new_opt = optimizer.update(grads, opt_state, params)
-                return loss, new_params, new_mstate, new_opt
+                return loss, new_params, new_mstate, new_opt, comm_state
 
             self._fused_step = (
                 key,
-                jax.jit(fused, donate_argnums=(0, 1, 2)),
+                jax.jit(fused, donate_argnums=(0, 1, 2, 3)),
             )
         return self._fused_step[1]
 
@@ -730,7 +754,11 @@ class PreparedModel:
         device array."""
         key = (criterion, optimizer, k)
         if key not in self._fused_scans:
-            def fused_scan(params, mstate, opt_state, base_rng, idxs, xs, ys, ws):
+            hook = self._comm_hook_name()
+
+            def fused_scan(
+                params, mstate, opt_state, comm_state, base_rng, idxs, xs, ys, ws
+            ):
                 stacked = (
                     idxs,
                     jnp.stack(xs),
@@ -739,7 +767,7 @@ class PreparedModel:
                 )
 
                 def body(carry, inp):
-                    p, ms, os_ = carry
+                    p, ms, os_, cs = carry
                     idx, x, y, w = inp
                     rng = jax.random.fold_in(base_rng, idx)
 
@@ -753,16 +781,22 @@ class PreparedModel:
                     (loss, new_ms), grads = jax.value_and_grad(
                         loss_fn, has_aux=True
                     )(p)
+                    # comm hook: same quantize -> clip -> update order as the
+                    # single fused step; the error-feedback residual rides in
+                    # the scan carry
+                    grads, cs = comm_lib.local_quantize(grads, cs, hook)
                     grads = self._maybe_clip(grads)
                     new_p, new_os = optimizer.update(grads, os_, p)
-                    return (new_p, new_ms, new_os), loss
+                    return (new_p, new_ms, new_os, cs), loss
 
-                (p, ms, os_), losses = jax.lax.scan(
-                    body, (params, mstate, opt_state), stacked
+                (p, ms, os_, cs), losses = jax.lax.scan(
+                    body, (params, mstate, opt_state, comm_state), stacked
                 )
-                return p, ms, os_, losses
+                return p, ms, os_, cs, losses
 
-            self._fused_scans[key] = jax.jit(fused_scan, donate_argnums=(0, 1, 2))
+            self._fused_scans[key] = jax.jit(
+                fused_scan, donate_argnums=(0, 1, 2, 3)
+            )
         return self._fused_scans[key]
 
 
@@ -786,6 +820,12 @@ class PreparedOptimizer:
         self._accum_grads = None
         self._accum_count = 0
         self._tree_add = None
+        # comm_hook="bf16_ef": the persistent error-feedback residual (a
+        # pytree like the gradients); None for stateless hooks
+        self._comm_state = None
+        # analytic per-update gradient-comm wire bytes (the counter), known
+        # once the model's parameters exist
+        self.grad_comm_bytes_per_step = None
 
     def zero_grad(self):
         if self.model._pending is not None:
@@ -813,6 +853,18 @@ class PreparedOptimizer:
             self.opt_state = self.optimizer.init(model.params)  # born sharded
         else:
             self.opt_state = self.optimizer.init(model.params)
+        hook = getattr(acc, "comm_hook", "none")
+        if hook == "bf16_ef" and self._comm_state is None:
+            self._comm_state = replicate(
+                acc.mesh, comm_lib.init_residual_tree(model._params)
+            )
+        self.grad_comm_bytes_per_step = comm_lib.comm_bytes_for_hook(
+            model._params, acc.mesh.devices.size, hook,
+            wus=getattr(acc, "weight_update_sharding", False),
+            # the managed path quantizes the XLA-aggregated gradient — the
+            # collective itself stays f32, and the counter says so
+            wire=False,
+        )
 
     def step(self):
         model = self.model
@@ -886,8 +938,8 @@ class PreparedOptimizer:
             return
         fn = self._get_apply_update()
         try:
-            model.params, self.opt_state = fn(
-                grads, self.opt_state, model.params, 1.0
+            model.params, self.opt_state, self._comm_state = fn(
+                grads, self.opt_state, model.params, self._comm_state, 1.0
             )
         except BaseException:
             self._poison_if_donated()
@@ -921,9 +973,9 @@ class PreparedOptimizer:
         model = self.model
         fn = self._get_apply_update()
         try:
-            model._params, self.opt_state = fn(
+            model._params, self.opt_state, self._comm_state = fn(
                 self._accum_grads, self.opt_state, model._params,
-                1.0 / self._accum_count,
+                self._comm_state, 1.0 / self._accum_count,
             )
         except BaseException:
             self._poison_if_donated()
@@ -932,19 +984,30 @@ class PreparedOptimizer:
         self._accum_count = 0
 
     def _get_apply_update(self):
-        """Jitted scale -> clip -> optimizer.update (clipping always applies
-        to the final, averaged gradient — never per micro-batch)."""
+        """Jitted scale -> comm hook -> clip -> optimizer.update (the hook and
+        the clip always apply to the final, averaged gradient — never per
+        micro-batch — matching the native cycle-boundary order)."""
         if self._update is None:
             clip = getattr(self.model.accelerator, "clip_grad_norm", None)
+            hook = self._comm_hook_name()
 
-            def apply(grads, opt_state, params, scale):
+            def apply(grads, opt_state, params, comm_state, scale):
                 grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+                grads, comm_state = comm_lib.local_quantize(
+                    grads, comm_state, hook
+                )
                 if clip is not None:
                     grads, _ = optim_lib.clip_grad_norm_(grads, clip)
-                return self.optimizer.update(grads, opt_state, params)
+                new_params, new_opt = self.optimizer.update(
+                    grads, opt_state, params
+                )
+                return new_params, new_opt, comm_state
 
-            self._update = jax.jit(apply, donate_argnums=(0, 1, 2))
+            self._update = jax.jit(apply, donate_argnums=(0, 1, 2, 3))
         return self._update
+
+    def _comm_hook_name(self) -> str:
+        return getattr(self.model.accelerator, "comm_hook", "none")
 
     def _poison_if_donated(self):
         """After a failed dispatch that may have donated the model/optimizer
@@ -952,11 +1015,12 @@ class PreparedOptimizer:
         checkpoint error, not JAX's obscure 'Array has been deleted'."""
         model = self.model
         leaves = jax.tree_util.tree_leaves(
-            (model._params, model._model_state, self.opt_state)
+            (model._params, model._model_state, self.opt_state, self._comm_state)
         )
         if any(getattr(l, "is_deleted", lambda: False)() for l in leaves):
             model._params = model._model_state = _LOST_TO_FAILED_FLUSH
             self.opt_state = None
+            self._comm_state = None
 
     def _run_fused(self, xb, yb, wb, criterion, step_idx, lazy_loss):
         """forward + backward + optimizer update as ONE jit dispatch (the
@@ -964,15 +1028,16 @@ class PreparedOptimizer:
         model = self.model
         fn = model._get_fused_step(criterion, self.optimizer)
         try:
-            loss, new_params, new_mstate, new_opt = fn(
+            loss, new_params, new_mstate, new_opt, new_comm = fn(
                 model._params, model._model_state, self.opt_state,
-                model._bwd_key, step_idx, xb, yb, wb,
+                self._comm_state, model._bwd_key, step_idx, xb, yb, wb,
             )
         except BaseException:
             self._poison_if_donated()
             raise
         model._params, model._model_state = new_params, new_mstate
         self.opt_state = new_opt
+        self._comm_state = new_comm
         lazy_loss._value = loss
 
     def flush(self):
@@ -1023,12 +1088,13 @@ class PreparedOptimizer:
         xs = tuple(e[0] for e in queue)
         ys = tuple(e[1] for e in queue)
         ws = tuple(e[2] for e in queue)
-        new_params, new_mstate, new_opt, losses = fn(
+        new_params, new_mstate, new_opt, new_comm, losses = fn(
             model._params, model._model_state, self.opt_state,
-            model._bwd_key, idxs, xs, ys, ws,
+            self._comm_state, model._bwd_key, idxs, xs, ys, ws,
         )
         model._params, model._model_state = new_params, new_mstate
         self.opt_state = new_opt
+        self._comm_state = new_comm
         for i, entry in enumerate(queue):
             lazy_loss = entry[5]
             lazy_loss._value_src = (losses, i)
@@ -1048,6 +1114,8 @@ class Accelerator:
         clip_grad_norm: Optional[float] = None,
         gradient_accumulation_steps: int = 1,
         weight_update_sharding: bool = False,
+        comm_hook: str = "none",
+        bucket_cap_mb: float = comm_lib.DEFAULT_BUCKET_CAP_MB,
     ):
         """``fuse_steps``: K > 1 batches per-step calls into one compiled
         lax.scan dispatch (the managed analog of the native scan fusion) —
@@ -1071,7 +1139,19 @@ class Accelerator:
         live as a flat vector SHARDED over the data axis and each chip
         computes only its parameter shard's update (XLA lowers the exchange
         to reduce-scatter + all-gather via sharding constraints; see
-        :class:`_FlatShardedUpdate` and arxiv.org/abs/2004.13336)."""
+        :class:`_FlatShardedUpdate` and arxiv.org/abs/2004.13336).
+
+        ``comm_hook``: gradient-communication hook ("none" | "bf16" |
+        "bf16_ef"), the managed-path analog of torch DDP's comm hooks
+        (parallel/comm.py). On this path XLA's partitioner inserts the
+        cross-replica psum inside backward, so the hook quantizes the
+        aggregated gradient through the wire dtype — with bf16_ef's
+        persistent error-feedback residual (round-tripped by
+        save_state/load_state) — preserving the hooks' convergence contract;
+        the genuine on-the-wire byte reduction is the explicit
+        (DistributedDataParallel, shard_map) path's property.
+        ``bucket_cap_mb`` is accepted for knob parity (bucketing is a
+        collective-granularity construct of the explicit path)."""
         self.mesh = mesh if mesh is not None else data_mesh(num_chips)
         key, _ = seeding.set_seed_based_on_rank(base_seed=seed)
         self._key = key
@@ -1092,6 +1172,12 @@ class Accelerator:
         # no-op semantics allow; the boundary step clears the accumulator).
         self.gradient_accumulation_steps = max(1, int(gradient_accumulation_steps))
         self.weight_update_sharding = bool(weight_update_sharding)
+        self.comm_hook = comm_lib.validate_hook(comm_hook)
+        self.bucket_cap_mb = float(bucket_cap_mb)
+        if self.bucket_cap_mb <= 0:
+            # same knob contract as DistributedDataParallel: a config that
+            # validates against one API must not crash the other
+            raise ValueError(f"bucket_cap_mb must be > 0, got {bucket_cap_mb!r}")
         if self.gradient_accumulation_steps > 1:
             if self.fuse_steps == "auto":
                 # accumulation owns the step cadence; auto-fusion yields
@@ -1244,8 +1330,10 @@ class Accelerator:
             # Adam moments computed against the PRE-restore weights must not
             # steer updates to the restored ones; this is a weights-only
             # restore, so the moments re-init to zero on the next step.
-            # load_state restores them losslessly.
+            # load_state restores them losslessly. The comm-hook residual is
+            # pre-restore compression error — it resets with them.
             opt.opt_state = None
+            opt._comm_state = None
         return model
 
     @staticmethod
@@ -1281,7 +1369,7 @@ class Accelerator:
         # still has the structure to save/load into; under
         # weight_update_sharding this also establishes the flat sharded layout
         optimizer._ensure_opt_state()
-        return {
+        tree = {
             "params": model._params,
             "model_state": model._model_state,
             "opt_state": optimizer.opt_state,
@@ -1289,6 +1377,13 @@ class Accelerator:
             "bwd_key": model._bwd_key,
             "bwd_counter": np.asarray(model._bwd_counter, np.int64),
         }
+        if optimizer._comm_state is not None:
+            # comm_hook="bf16_ef": the error-feedback residual is training
+            # state — dropping it on resume would re-bias the first steps
+            # after restore. Only present when the hook carries state, so
+            # hook-less checkpoints keep their historical structure.
+            tree["comm_state"] = optimizer._comm_state
+        return tree
 
     def save_state(
         self,
@@ -1360,6 +1455,8 @@ class Accelerator:
             )
         else:
             optimizer.opt_state = replicate(self.mesh, restored["opt_state"])
+        if "comm_state" in restored:
+            optimizer._comm_state = replicate(self.mesh, restored["comm_state"])
         self._key = restored["rng_key"]
         model._bwd_key = restored["bwd_key"]
         model._bwd_counter = int(restored["bwd_counter"])

@@ -47,6 +47,13 @@ TRAINING_DEFAULTS = {
     "weight_update_sharding": False,  # ZeRO-1 on ICI (arxiv 2004.13336):
     # reduce-scatter grads, 1/N-shard optimizer update per chip (moments
     # sharded over the data axis), all-gather params. shard_map mode only.
+    "comm_hook": "none",  # gradient-comm hook (torch DDP comm-hook analog,
+    # parallel/comm.py): "bf16" = bucketed bf16-compressed allreduce (half
+    # the gradient interconnect bytes on the explicit path); "bf16_ef" adds
+    # the persistent error-feedback residual (checkpointed) so compression
+    # error doesn't bias convergence
+    "bucket_cap_mb": 25,  # comm-hook bucket size cap (torch's bucket_cap_mb):
+    # small tensors coalesce into one collective per <= cap-sized bucket
     "prefetch": True,  # background-thread host batch prefetch
     "deferred_metrics": False,  # managed path: epoch-end (not per-batch) metric sync
     "fuse_steps": "auto",  # managed path: K step()s per dispatch (auto, with

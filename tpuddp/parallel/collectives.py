@@ -75,6 +75,39 @@ def reduce_scatter(x, axis_name: str = DATA_AXIS, scatter_dimension: int = 0):
     )
 
 
+def bucketed_psum(vec, buckets, wire_dtype, axis_name: Optional[str] = DATA_AXIS):
+    """Bucketed compressed psum over a flat f32 vector (the gradient-comm
+    hook's reduce primitive, parallel/comm.py): each contiguous ``(start,
+    end)`` bucket is cast to ``wire_dtype``, summed across the axis — the
+    collective's operand IS the wire dtype, so bf16 halves the interconnect
+    payload — and decompressed back to f32. ``axis_name=None`` skips the
+    collective (auto mode: XLA's partitioner already inserted the reduction)
+    and only round-trips the quantization. Returns the reassembled f32
+    vector (SUM, not mean — callers divide by world)."""
+    parts = []
+    for s, e in buckets:
+        b = lax.slice(vec, (s,), (e,)).astype(wire_dtype)
+        if axis_name is not None:
+            b = lax.psum(b, axis_name)
+        parts.append(b.astype(jnp.float32))
+    return jnp.concatenate(parts)
+
+
+def psum_scatter_compressed(vec, wire_dtype, axis_name: str = DATA_AXIS):
+    """Compressed reduce-scatter of a flat vector (the comm hooks' weight-
+    update-sharding composition): the whole vector is cast to ``wire_dtype``
+    and ``psum_scatter``'d tiled — each replica receives the summed
+    contiguous 1/N shard with the wire carrying the compressed dtype — then
+    decompressed to f32. Returns ``(f32_sum_shard, compressed_send)``; the
+    send is handed back so error-feedback callers can form ``sent -
+    kept``."""
+    comp = vec.astype(wire_dtype)
+    shard = lax.psum_scatter(
+        comp, axis_name, scatter_dimension=0, tiled=True
+    ).astype(jnp.float32)
+    return shard, comp
+
+
 def ppermute(x, perm, axis_name: str = DATA_AXIS):
     """Point-to-point ring permutation (building block for ring algorithms)."""
     return jax.tree_util.tree_map(

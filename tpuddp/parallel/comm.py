@@ -1,0 +1,282 @@
+"""Gradient-communication hooks — the tpuddp rebuild of torch DDP's bucketed
+allreduce + comm-hook machinery (SURVEY.md §2b: DDP's ``bf16_compress_hook``
+et al., the one reference capability tpuddp had not reimplemented natively).
+
+torch DDP flattens gradients into size-capped buckets and lets a registered
+comm hook transform each bucket's allreduce (``default_hooks.bf16_compress_hook``
+casts the bucket to bf16, allreduces half the bytes, and decompresses).
+tpuddp expresses the same pipeline *inside the compiled step*:
+
+1. the gradient pytree is flattened into ONE padded f32 vector with the
+   existing :class:`~tpuddp.training.step.FlatParamSpec` vectorizer;
+2. the vector is split into size-capped contiguous **buckets**
+   (``bucket_cap_mb``, torch's knob/default): whole leaves are packed
+   greedily in deterministic ``tree_flatten`` order, so many small tensors
+   coalesce into one collective instead of paying per-tensor latency, while
+   an oversized leaf gets a bucket of its own;
+3. each bucket runs the configured **hook**:
+
+   - ``"none"``  — today's full-precision ``lax.pmean`` (the default; the
+     bucketed flat path is bypassed entirely, zero behavior change);
+   - ``"bf16"``  — cast the bucket to bf16, ``lax.psum`` it (HALF the
+     interconnect bytes), decompress to f32, divide by world;
+   - ``"bf16_ef"`` — ``bf16`` plus **error feedback**: each replica keeps a
+     persistent local residual of what compression discarded and adds it
+     back into the next step's send, so quantization error accumulates into
+     later updates instead of biasing the trajectory (1-bit-Adam/DynamiQ
+     lineage; arxiv.org/abs/2602.08923). The residual is carried in
+     ``TrainState.comm_state`` and checkpoints with the rest of the state.
+
+Under ``weight_update_sharding`` the compressed payload is **reduce-
+scattered** instead: the bf16 vector is ``psum_scatter``'d whole (the scatter
+hands every replica a contiguous 1/N shard aligned with its optimizer-moment
+shard, so the bucket partition would scramble shard ownership — buckets
+degenerate to the full vector there and remain an accounting construct).
+Gradient wire bytes still halve; the f32 parameter all-gather is unchanged.
+
+Modes and honesty:
+
+- ``mode="shard_map"`` (explicit): the emitted program requests the
+  collective in the wire dtype — the lowered step carries a bf16
+  all-reduce/reduce-scatter (asserted in tests/test_comm.py; TPU ICI runs
+  bf16 collectives natively, while backends without them — the CPU test
+  world — legalize to f32 at compile time, preserving the quantization
+  numerics). :func:`comm_bytes_for_hook` is the measured-artifact counter
+  for the reduction.
+- ``mode="auto"`` / the managed Accelerator: XLA inserts the cross-replica
+  psum inside backward where a dtype cast cannot be interposed, so the hook
+  quantizes the *aggregated* gradient with the same error-feedback residual
+  — the convergence contract (what the numerics tests pin) is preserved,
+  but the byte reduction is a property of the explicit path only, and the
+  counter accounts for it honestly (``comm_bytes_for_hook(wire=False)``
+  reports the f32 payload those paths actually reduce).
+  :func:`local_quantize` is that tree-level emulation.
+
+Per-replica residual layout (shard_map): a flat ``(world * total,)`` f32
+vector sharded ``P("data")`` over the mesh — inside ``shard_map`` each
+replica sees its own ``(total,)`` slice, exactly like the weight-update-
+sharded optimizer moments. Checkpointing gathers it cross-host like any
+other sharded leaf (training/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+COMM_HOOKS = ("none", "bf16", "bf16_ef")
+
+# torch DDP's bucket_cap_mb default. Small enough that many buckets exist on
+# real models (XLA can pipeline the collectives), large enough that small
+# tensors coalesce instead of paying per-tensor collective latency.
+DEFAULT_BUCKET_CAP_MB = 25
+
+_WIRE_DTYPES = {"bf16": jnp.bfloat16, "bf16_ef": jnp.bfloat16}
+_F32_BYTES = 4
+
+
+def wire_dtype(hook: str):
+    """The on-the-wire dtype of a hook's gradient collective (f32 for none)."""
+    return _WIRE_DTYPES.get(hook, jnp.float32)
+
+
+def wire_itemsize(hook: str) -> int:
+    return jnp.dtype(wire_dtype(hook)).itemsize
+
+
+def validate_hook(hook: str) -> str:
+    if hook not in COMM_HOOKS:
+        raise ValueError(f"unknown comm_hook {hook!r}; one of {COMM_HOOKS}")
+    return hook
+
+
+def make_buckets(
+    sizes: Tuple[int, ...], total: int, bucket_cap_mb: float = DEFAULT_BUCKET_CAP_MB
+) -> Tuple[Tuple[int, int], ...]:
+    """Partition ``[0, total)`` into contiguous ``(start, end)`` buckets.
+
+    ``sizes`` are the flat-vector leaf sizes in ``tree_flatten`` order (the
+    deterministic order :func:`~tpuddp.training.step._tree_to_vec`
+    concatenates in), so bucket boundaries land on whole-leaf boundaries:
+    leaves are packed greedily until the next leaf would push the bucket past
+    ``bucket_cap_mb`` of f32 payload; a single leaf larger than the cap gets
+    its own bucket (torch DDP's rule — tensors are never split). The final
+    bucket absorbs the spec's world-multiple zero padding (``total`` minus
+    the raw leaf sum), so the buckets always cover the padded vector exactly.
+    """
+    if bucket_cap_mb <= 0:
+        raise ValueError(f"bucket_cap_mb must be > 0, got {bucket_cap_mb!r}")
+    cap_elems = max(1, int(bucket_cap_mb * 1024 * 1024) // _F32_BYTES)
+    buckets = []
+    start = 0
+    cursor = 0
+    filled = 0
+    for size in sizes:
+        if filled and filled + size > cap_elems:
+            buckets.append((start, cursor))
+            start, filled = cursor, 0
+        cursor += size
+        filled += size
+    # the tail bucket: remaining leaves plus the zero padding up to `total`
+    if cursor < total or filled or start < total:
+        buckets.append((start, total))
+    assert buckets and buckets[0][0] == 0 and buckets[-1][1] == total
+    return tuple(buckets)
+
+
+class GradComm(NamedTuple):
+    """Static comm plan for one (model, world, hook) triple: the flat spec the
+    gradients vectorize through, the bucket partition, and the hook."""
+
+    spec: "FlatParamSpec"  # noqa: F821 - tpuddp.training.step.FlatParamSpec
+    buckets: Tuple[Tuple[int, int], ...]
+    hook: str
+    world: int
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def compressed(self) -> bool:
+        return self.hook in ("bf16", "bf16_ef")
+
+    @property
+    def needs_residual(self) -> bool:
+        return self.hook == "bf16_ef"
+
+    # -- residual lifecycle -------------------------------------------------
+    def init_residual(self, per_replica: bool) -> Optional[np.ndarray]:
+        """Host zeros for ``TrainState.comm_state``: ``(world * total,)`` when
+        the residual is per-replica (shard_map — placed ``P("data")`` so each
+        replica owns its slice) or ``(total,)`` replicated (auto mode, where
+        the hook quantizes the already-aggregated gradient)."""
+        if not self.needs_residual:
+            return None
+        n = self.spec.total * (self.world if per_replica else 1)
+        return np.zeros((n,), np.float32)
+
+    # -- in-jit hook pipeline ----------------------------------------------
+    def reduce(self, grads, residual, axis_name: Optional[str]):
+        """The bucketed hook pipeline: grads tree in, cross-replica MEAN
+        grads tree out, plus the new residual. ``axis_name=None`` is the
+        auto-mode emulation (no collective; XLA already reduced)."""
+        from tpuddp.parallel.collectives import bucketed_psum
+        from tpuddp.training.step import _tree_to_vec, _vec_to_tree
+
+        g_vec = _tree_to_vec(grads, self.spec)
+        send = g_vec if residual is None else g_vec + residual
+        reduced = bucketed_psum(
+            send, self.buckets, wire_dtype(self.hook), axis_name
+        )
+        if axis_name is not None:
+            reduced = reduced / self.world
+        new_residual = residual
+        if self.needs_residual:
+            # what the wire kept is elementwise, so the whole-vector round
+            # trip equals the per-bucket casts that were actually sent
+            new_residual = send - send.astype(wire_dtype(self.hook)).astype(
+                jnp.float32
+            )
+        return _vec_to_tree(reduced, self.spec), new_residual
+
+    def reduce_scatter(self, g_vec, residual, axis_name: str):
+        """The weight-update-sharding composition: compress the whole padded
+        vector and ``psum_scatter`` the bf16 payload — each replica receives
+        the f32-decompressed MEAN gradient for its contiguous 1/N shard
+        (aligned with its optimizer-moment shard). Returns
+        ``(g_shard_mean_f32, new_residual)``; the residual stays full-length
+        and local (it is this replica's compression error over the whole
+        vector, not its shard's)."""
+        from tpuddp.parallel.collectives import psum_scatter_compressed
+
+        send = g_vec if residual is None else g_vec + residual
+        shard, comp = psum_scatter_compressed(
+            send, wire_dtype(self.hook), axis_name
+        )
+        shard = shard / self.world
+        new_residual = residual
+        if self.needs_residual:
+            new_residual = send - comp.astype(jnp.float32)
+        return shard, new_residual
+
+def make_grad_comm(
+    params,
+    world: int,
+    comm_hook: str = "none",
+    bucket_cap_mb: float = DEFAULT_BUCKET_CAP_MB,
+    flat_spec=None,
+) -> Optional[GradComm]:
+    """Build the comm plan for ``params`` (None for hook "none" — the legacy
+    pmean path needs no plan; accounting for it comes from a bf16 plan's
+    sibling via :func:`comm_bytes_for_hook`). ``flat_spec`` reuses an
+    existing :class:`FlatParamSpec` (the weight-update-sharding one) so the
+    residual aligns with the scattered vector."""
+    validate_hook(comm_hook)
+    if comm_hook == "none":
+        return None
+    from tpuddp.training.step import make_flat_param_spec
+
+    spec = flat_spec if flat_spec is not None else make_flat_param_spec(params, world)
+    buckets = make_buckets(spec.sizes, spec.total, bucket_cap_mb)
+    return GradComm(spec=spec, buckets=buckets, hook=comm_hook, world=world)
+
+
+def comm_bytes_for_hook(
+    params, world: int, comm_hook: str, wus: bool = False, wire: bool = True
+) -> int:
+    """Analytic per-replica wire payload of ONE gradient reduction (bytes) —
+    the counter the dryrun/bench compare across hooks: the operand bytes
+    entering the gradient collective, in its wire dtype. Ring-transfer
+    multipliers (2(N-1)/N for allreduce, (N-1)/N for reduce-scatter) are
+    topology constants that cancel in any same-shape comparison, so the
+    counter reports the payload itself — the quantity the hook changes.
+    ``wus`` counts the gradient reduce-scatter only (the f32 parameter
+    all-gather is a separate, hook-independent exchange). ``wire=False``
+    (``mode="auto"`` / the managed Accelerator, where XLA inserts the psum
+    and the hook only emulates the quantization) accounts the collective at
+    f32 regardless of hook — the counter must never record a byte cut that
+    did not reach the wire."""
+    validate_hook(comm_hook)
+    from tpuddp.training.step import make_flat_param_spec
+
+    spec = make_flat_param_spec(params, world)
+    if not wire:
+        comm_hook = "none"
+    if comm_hook == "none" and not wus:
+        # the tree-level pmean reduces exactly the raw (unpadded) leaf
+        # elements; flat-vector paths carry the world-multiple padding
+        return sum(spec.sizes) * _F32_BYTES
+    return spec.total * wire_itemsize(comm_hook)
+
+
+def local_quantize(grads, residual, hook: str):
+    """Tree-level hook emulation for the managed/auto path: quantize the
+    (already globally-aggregated) gradient through the wire dtype, with the
+    same error-feedback residual semantics as the explicit path. ``residual``
+    is a pytree like ``grads`` (or None for hook "bf16"). Returns
+    ``(quantized_grads, new_residual)``."""
+    validate_hook(hook)
+    if hook == "none":
+        return grads, residual
+    dt = wire_dtype(hook)
+    if hook == "bf16":
+        return (
+            jax.tree_util.tree_map(
+                lambda g: g.astype(dt).astype(jnp.float32), grads
+            ),
+            residual,
+        )
+    send = jax.tree_util.tree_map(lambda g, r: g + r, grads, residual)
+    quant = jax.tree_util.tree_map(
+        lambda s: s.astype(dt).astype(jnp.float32), send
+    )
+    new_residual = jax.tree_util.tree_map(lambda s, q: s - q, send, quant)
+    return quant, new_residual
+
+
+def init_residual_tree(params):
+    """Zeros-like residual pytree for :func:`local_quantize`'s bf16_ef."""
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(np.shape(p), jnp.float32), params
+    )

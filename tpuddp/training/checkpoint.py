@@ -145,6 +145,21 @@ def load(path: str, like: Any) -> Any:
                     f"{t_raw_shape}"
                 )
             leaves.append(jax.random.wrap_key_data(raw))
+        elif key == ".comm_state" or key.startswith("['comm_state']"):
+            # Anchored to the TrainState field / managed state-dict entry —
+            # a model parameter whose own name merely contains "comm_state"
+            # must still hit the missing-leaf error below.
+            # Forward-compat: a checkpoint written before the gradient-comm
+            # hook was enabled (comm_hook="none" saves no residual leaf)
+            # loads into a bf16_ef template by keeping the template's
+            # zero-initialized residual — the exact state a fresh compressed
+            # run starts from, so resume is correct, just logged.
+            logger.warning(
+                "checkpoint %s predates comm_hook state: leaf %r starts at "
+                "its zero initialization",
+                path, key,
+            )
+            leaves.append(template)
         else:
             raise KeyError(f"checkpoint {path} is missing leaf {key!r}")
     return jax.tree_util.tree_unflatten(treedef, leaves)

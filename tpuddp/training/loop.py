@@ -35,6 +35,7 @@ from tpuddp.resilience.preemption import (
 from tpuddp.training import checkpoint as ckpt
 from tpuddp.training.step import accumulate_metrics, finalize_metrics, stack_batches
 from tpuddp.utils.observability import (
+    CommBytesCounter,
     MetricsWriter,
     check_finite,
     maybe_start_profiler,
@@ -64,13 +65,16 @@ def resolve_scan_steps(
     dominates per-step time otherwise (BASELINE.md: ~7x on the toy model
     through a tunneled TPU; the tunnel's RTT swings 7-240 ms between
     sessions and K is the amortization lever). The staged ``(K, batch, ...)``
-    super-chunk must stay bounded, so when ``batch_nbytes`` (one host
-    batch's input bytes) is known, K is capped to a ~256 MB staging budget;
-    unknown-size batches on non-small models fall back to a conservative 32.
-    Small models (whole parameter set under ~4 MB) always get 64 — their
-    batches are small by construction and dispatch latency dominates even
-    deeper (the bench's toy-MLP K-sweep). Any integer pins K explicitly; 1
-    disables fusion (one dispatch per batch, the reference's cadence)."""
+    super-chunk must stay bounded, so whenever ``batch_nbytes`` (one host
+    batch's input bytes) is known the ~256 MB staging budget caps K — for
+    EVERY model size: a small model fed large batches still stages
+    K x batch bytes, so the budget binds there too. Model size only decides
+    the starting cap when batch bytes are unknowable: small models (whole
+    parameter set under ~4 MB) start from 64 — dispatch latency dominates
+    them even deeper (the bench's toy-MLP K-sweep) — while unknown-size
+    batches on non-small models fall back to a conservative 32. Any integer
+    pins K explicitly; 1 disables fusion (one dispatch per batch, the
+    reference's cadence)."""
     if scan_steps in (None, "auto"):
         small = param_bytes is not None and param_bytes < _SMALL_PARAM_BYTES
         cap = _AUTO_SCAN_CAP if (small or batch_nbytes) else _AUTO_SCAN_FALLBACK_CAP
@@ -98,7 +102,11 @@ def _pad_to_cycles(chunk, accum: int):
     whole number of accumulation cycles. Padding batches carry zero sample
     weight, so they contribute nothing to gradients, metrics, or BatchNorm
     statistics (nn/loss.py, nn/norm.py) — the cycle's update averages over
-    the live samples only."""
+    the live samples only. Cost: up to ``accum - 1`` wasted tail micro-steps
+    per epoch (each pad batch pays a full forward+backward whose result is
+    masked to zero) — bounded, once per epoch, and the price of keeping the
+    scan shape static; epochs whose batch count is a multiple of ``accum``
+    pay nothing."""
     x0, y0, w0 = chunk[-1]
     pad = (-len(chunk)) % accum
     return chunk + [(x0, y0, np.zeros_like(w0))] * pad
@@ -247,6 +255,12 @@ def run_training_loop(
 
     history = []
     metrics_writer = MetricsWriter(save_dir)
+    # gradient-comm wire-bytes accounting (parallel/comm.py counter): one
+    # optimizer update per accumulation cycle; the payload per update is
+    # static, so the counter is free host arithmetic next to the device step
+    comm_counter = CommBytesCounter(
+        getattr(ddp, "grad_comm_bytes_per_step", None)
+    )
     profiling = maybe_start_profiler(save_dir)  # $TPUDDP_PROFILE hook
 
     multihost = jax.process_count() > 1
@@ -386,6 +400,10 @@ def run_training_loop(
                 test_accuracy = float("nan")
 
             epoch_time = time.perf_counter() - t0
+            # optimizer updates this epoch: one per accumulation cycle over
+            # the dispatched micro-batches (the padded tail rounds up)
+            epoch_updates = -(-len(train_loader) // accum)
+            comm_counter.add_updates(epoch_updates)
             record = {
                 "epoch": epoch,
                 "train_loss": train_loss,
@@ -396,6 +414,7 @@ def run_training_loop(
                 "epoch_time_s": epoch_time,
                 "samples_per_sec": (train_m["n"] + eval_m["n"]) / max(epoch_time, 1e-9),
             }
+            record.update(comm_counter.snapshot(epoch_updates))
             history.append(record)
             metrics_writer.write(record)
             check_finite(train_loss, "train loss")  # $TPUDDP_DEBUG_NANS guard

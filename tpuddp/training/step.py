@@ -95,11 +95,13 @@ def _vec_to_tree(vec, spec: FlatParamSpec):
     return jax.tree_util.tree_unflatten(spec.treedef, leaves)
 
 
-def sharded_state_spec(opt_state_template, spec: FlatParamSpec):
+def sharded_state_spec(opt_state_template, spec: FlatParamSpec, comm=None):
     """The shard_map PartitionSpec pytree for a TrainState whose optimizer
     moment vectors are sharded over the data axis (weight-update sharding):
     every (total,)-sized 1-D leaf of the optimizer state is P(DATA_AXIS),
-    everything else replicated."""
+    everything else replicated. ``comm`` (a GradComm with an error-feedback
+    residual) additionally marks ``comm_state`` sharded — the residual is
+    per-replica local state, laid out like the moment shards."""
     def leaf_spec(l):
         if getattr(l, "ndim", None) == 1 and l.shape[0] == spec.total:
             return P(DATA_AXIS)
@@ -107,7 +109,20 @@ def sharded_state_spec(opt_state_template, spec: FlatParamSpec):
 
     opt_spec = jax.tree_util.tree_map(leaf_spec, opt_state_template)
     return TrainState(
-        params=P(), model_state=P(), opt_state=opt_spec, step=P(), rng=P()
+        params=P(), model_state=P(), opt_state=opt_spec, step=P(), rng=P(),
+        comm_state=(
+            P(DATA_AXIS) if comm is not None and comm.needs_residual else P()
+        ),
+    )
+
+
+def comm_state_spec():
+    """The shard_map PartitionSpec pytree for a TrainState whose ONLY sharded
+    member is the per-replica comm-hook residual (comm_hook="bf16_ef" without
+    weight-update sharding): everything replicated except ``comm_state``."""
+    return TrainState(
+        params=P(), model_state=P(), opt_state=P(), step=P(), rng=P(),
+        comm_state=P(DATA_AXIS),
     )
 
 
@@ -212,13 +227,18 @@ def _make_update_fn(
     axis_name: Optional[str],
     clip_grad_norm: Optional[float],
     wus_spec: Optional[FlatParamSpec],
+    comm=None,
 ):
     """The optimizer half of the train step: replica-local mean gradients in,
-    ``(new_params, new_opt_state)`` out. Owns the cross-replica exchange
-    (pmean, or reduce-scatter/all-gather under weight-update sharding) and the
-    clip-after-aggregate."""
+    ``(new_params, new_opt_state, new_comm_state)`` out. Owns the
+    cross-replica exchange (pmean, a compressed bucketed psum when a comm
+    hook is configured, or reduce-scatter/all-gather under weight-update
+    sharding) and the clip-after-aggregate. ``comm`` is a
+    :class:`tpuddp.parallel.comm.GradComm` plan (None or hook "none" keeps
+    the legacy full-precision path byte-identical); ``comm_state`` threads
+    the bf16_ef error-feedback residual through the step."""
 
-    def apply_update(params, opt_state, grads):
+    def apply_update(params, opt_state, grads, comm_state):
         if wus_spec is not None:
             # Weight-update sharding (the cross-replica weight-update recipe
             # of arxiv.org/abs/2004.13336, ZeRO-1's TPU-native shape): instead
@@ -235,12 +255,20 @@ def _make_update_fn(
             world = wus_spec.world
             shard_n = wus_spec.total // world
             g_vec = _tree_to_vec(grads, wus_spec)
-            g_shard = (
-                jax.lax.psum_scatter(
-                    g_vec, axis_name, scatter_dimension=0, tiled=True
+            if comm is not None and comm.compressed:
+                # comm-hook composition: scatter the COMPRESSED payload —
+                # half the gradient wire bytes; the bf16_ef residual stays
+                # full-length and replica-local (see comm.reduce_scatter)
+                g_shard, comm_state = comm.reduce_scatter(
+                    g_vec, comm_state, axis_name
                 )
-                / world
-            )
+            else:
+                g_shard = (
+                    jax.lax.psum_scatter(
+                        g_vec, axis_name, scatter_dimension=0, tiled=True
+                    )
+                    / world
+                )
             if clip_grad_norm is not None:
                 # the global norm of a sharded vector is one scalar psum away;
                 # padding zeros contribute nothing
@@ -261,9 +289,15 @@ def _make_update_fn(
             new_p_vec = jax.lax.all_gather(
                 new_p_shard, axis_name, tiled=True
             )
-            return _vec_to_tree(new_p_vec, wus_spec), new_opt_state
+            return _vec_to_tree(new_p_vec, wus_spec), new_opt_state, comm_state
 
-        if axis_name is not None:
+        if comm is not None and comm.compressed:
+            # bucketed compressed allreduce (torch DDP comm-hook analog):
+            # flatten -> per-bucket bf16 psum -> f32 decompress -> mean.
+            # With axis_name=None (auto mode) this is the local quantization
+            # emulation — XLA's implicit psum already aggregated.
+            grads, comm_state = comm.reduce(grads, comm_state, axis_name)
+        elif axis_name is not None:
             # THE DDP step: average gradients across replicas (reference
             # :125's implicit NCCL allreduce). In auto mode XLA inserts
             # this itself.
@@ -273,7 +307,8 @@ def _make_update_fn(
             # *averaged* grad, identically on all replicas.
             grads, _ = _optim.clip_grad_norm_(grads, clip_grad_norm)
 
-        return optimizer.update(grads, opt_state, params)
+        new_params, new_opt_state = optimizer.update(grads, opt_state, params)
+        return new_params, new_opt_state, comm_state
 
     return apply_update
 
@@ -288,6 +323,7 @@ def _make_train_core(
     augment: Optional[Callable],
     remat: bool = False,
     wus_spec: Optional[FlatParamSpec] = None,
+    comm=None,
 ):
     _validate_sync_buffers(model, axis_name, sync_buffers)
     if wus_spec is not None and axis_name is None:
@@ -299,12 +335,14 @@ def _make_train_core(
     grad_core = _make_grad_core(
         model, criterion, axis_name, sync_buffers, augment, remat
     )
-    apply_update = _make_update_fn(optimizer, axis_name, clip_grad_norm, wus_spec)
+    apply_update = _make_update_fn(
+        optimizer, axis_name, clip_grad_norm, wus_spec, comm=comm
+    )
 
     def core(state: TrainState, x, y, w):
         grads, model_state, loss, n = grad_core(state, x, y, w)
-        new_params, new_opt_state = apply_update(
-            state.params, state.opt_state, grads
+        new_params, new_opt_state, new_comm = apply_update(
+            state.params, state.opt_state, grads, state.comm_state
         )
         metrics = {
             "loss_sum": (loss * n)[None],  # sample-weighted, reference :131
@@ -316,6 +354,7 @@ def _make_train_core(
             opt_state=new_opt_state,
             step=state.step + 1,
             rng=state.rng,
+            comm_state=new_comm,
         )
         return new_state, metrics
 
@@ -353,16 +392,21 @@ def build_train_step(
     remat: bool = False,
     wus_spec: Optional[FlatParamSpec] = None,
     state_spec=None,
+    comm=None,
 ):
     """Compile the DP train step over ``mesh``. Returns
     ``step(state, (x, y, w)) -> (new_state, metrics)`` with donated state.
     ``wus_spec``/``state_spec`` (from :func:`make_flat_param_spec` /
-    :func:`sharded_state_spec`) switch on weight-update sharding."""
+    :func:`sharded_state_spec`) switch on weight-update sharding. ``comm``
+    (a :class:`tpuddp.parallel.comm.GradComm`) switches the gradient
+    exchange to the bucketed compressed hook pipeline; a bf16_ef hook needs
+    a ``state_spec`` marking ``comm_state`` sharded (:func:`comm_state_spec`
+    or :func:`sharded_state_spec` with ``comm=``)."""
     if mode == "shard_map":
         st_spec = state_spec if state_spec is not None else P()
         core = _make_train_core(
             model, criterion, optimizer, DATA_AXIS, sync_buffers,
-            clip_grad_norm, augment, remat, wus_spec=wus_spec,
+            clip_grad_norm, augment, remat, wus_spec=wus_spec, comm=comm,
         )
         fn = shard_map(
             core,
@@ -375,7 +419,7 @@ def build_train_step(
     elif mode == "auto":
         core = _make_train_core(
             model, criterion, optimizer, None, sync_buffers,
-            clip_grad_norm, augment, remat, wus_spec=wus_spec,
+            clip_grad_norm, augment, remat, wus_spec=wus_spec, comm=comm,
         )
         jitted = jax.jit(
             core,
@@ -406,6 +450,7 @@ def build_train_scan_step(
     wus_spec: Optional[FlatParamSpec] = None,
     state_spec=None,
     grad_accumulation: int = 1,
+    comm=None,
 ):
     """Multi-step variant: runs K train steps per jit call via ``lax.scan``.
 
@@ -450,7 +495,7 @@ def build_train_scan_step(
     if accum == 1:
         core = _make_train_core(
             model, criterion, optimizer, axis_name, sync_buffers,
-            clip_grad_norm, augment, remat, wus_spec=wus_spec,
+            clip_grad_norm, augment, remat, wus_spec=wus_spec, comm=comm,
         )
 
         def multi(state: TrainState, xs, ys, ws):
@@ -467,7 +512,7 @@ def build_train_scan_step(
             model, criterion, axis_name, sync_buffers, augment, remat
         )
         apply_update = _make_update_fn(
-            optimizer, axis_name, clip_grad_norm, wus_spec
+            optimizer, axis_name, clip_grad_norm, wus_spec, comm=comm
         )
 
         def multi(state: TrainState, xs, ys, ws):
@@ -505,6 +550,7 @@ def build_train_scan_step(
                         opt_state=st.opt_state,
                         step=st.step + 1,
                         rng=st.rng,
+                        comm_state=st.comm_state,
                     )
                     m = {"loss_sum": (loss * n)[None], "n": n[None]}
                     return (st, gacc, nacc + n), m
@@ -516,8 +562,8 @@ def build_train_scan_step(
                 # (guard only the all-padding nacc==0 case, like nn/loss.py)
                 denom = jnp.where(nacc == 0, 1.0, nacc)
                 g = jax.tree_util.tree_map(lambda a: a / denom, gacc)
-                new_params, new_opt_state = apply_update(
-                    st.params, st.opt_state, g
+                new_params, new_opt_state, new_comm = apply_update(
+                    st.params, st.opt_state, g, st.comm_state
                 )
                 st = TrainState(
                     params=new_params,
@@ -525,6 +571,7 @@ def build_train_scan_step(
                     opt_state=new_opt_state,
                     step=st.step,
                     rng=st.rng,
+                    comm_state=new_comm,
                 )
                 metrics = jax.tree_util.tree_map(
                     lambda a: jnp.sum(a, axis=0), stacked

@@ -23,11 +23,18 @@ class TrainState:
     opt_state: Any
     step: jax.Array
     rng: jax.Array
+    # Gradient-comm hook state (parallel/comm.py): the per-replica
+    # error-feedback residual under comm_hook="bf16_ef" — a flat f32 vector
+    # sharded over the data axis in shard_map mode, replicated in auto mode.
+    # None (an empty pytree node: no leaf, no checkpoint entry) when the
+    # configured hook carries no state, so every pre-existing TrainState
+    # construction and checkpoint stays byte-identical.
+    comm_state: Any = None
 
 
 jax.tree_util.register_dataclass(
     TrainState,
-    data_fields=["params", "model_state", "opt_state", "step", "rng"],
+    data_fields=["params", "model_state", "opt_state", "step", "rng", "comm_state"],
     meta_fields=[],
 )
 

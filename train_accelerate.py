@@ -300,6 +300,10 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         clip_grad_norm=training.get("clip_grad_norm"),
         gradient_accumulation_steps=accum,
         weight_update_sharding=bool(training.get("weight_update_sharding", False)),
+        # gradient-comm hook (managed emulation; parallel/comm.py): same
+        # training.comm_hook knob as the native entrypoint
+        comm_hook=str(training.get("comm_hook") or "none"),
+        bucket_cap_mb=float(training.get("bucket_cap_mb") or 25),
     )
 
     # Data + model (reference :118-122); placement is implicit on this path.

@@ -129,6 +129,10 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         # batch-size knob): one optimizer update per A micro-batches, fused
         # into the scan step — same knob name as the managed path
         grad_accumulation=int(training.get("gradient_accumulation_steps") or 1),
+        # gradient-comm hook (torch DDP comm-hook analog, parallel/comm.py):
+        # bf16/bf16_ef halve the gradient interconnect bytes per step
+        comm_hook=str(training.get("comm_hook") or "none"),
+        bucket_cap_mb=float(training.get("bucket_cap_mb") or 25),
     )
     in_hw = size if size else train_ds.images.shape[1]
     state = ddp.init_state(
