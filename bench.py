@@ -538,8 +538,12 @@ def emit_summary(ours, baseline, out_path=None):
     path = out_path or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_results.json"
     )
+    # strict JSON on disk: a non-finite row value (a failed/blown-up config)
+    # lands as null, never the bare NaN token strict parsers reject
+    from tpuddp.utils.observability import json_sanitize
+
     with open(path, "w") as f:
-        json.dump(payload, f, indent=2)
+        json.dump(json_sanitize(payload), f, indent=2, allow_nan=False)
         f.write("\n")
     log(f"full per-config results -> {path}")
     return {
@@ -736,8 +740,14 @@ def main(argv=None):
     baseline = bench_torch_cpu()
     # LAST stdout line: the compact machine-readable summary (the driver
     # parses exactly this line; the full per-config dict went to
-    # bench_results.json inside emit_summary)
-    print(json.dumps(emit_summary(ours, baseline)), flush=True)
+    # bench_results.json inside emit_summary). Strict JSON: non-finite
+    # values serialize as null, never a bare NaN token.
+    from tpuddp.utils.observability import json_sanitize
+
+    print(
+        json.dumps(json_sanitize(emit_summary(ours, baseline)), allow_nan=False),
+        flush=True,
+    )
 
 
 if __name__ == "__main__":

@@ -1,0 +1,98 @@
+"""Desync worker for the chaos suite (launched by test_chaos.py).
+
+Simulates the guard's target failure — ONE device's copy of a replicated
+parameter silently diverging (bad host, bit flip, desynced update) — by
+rebuilding a leaf with ``make_array_from_single_device_arrays`` so device 3's
+buffer differs, then runs the epoch driver with the desync auditor armed
+(``guard.audit_every_n_epochs=1``) through the full spawn path so the
+exit-code contract is live:
+
+- mode ``exit``:     the audit at the next epoch boundary must name the leaf
+                     and exit ``EXIT_DESYNC`` (77).
+- mode ``rollback``: epoch 0 first trains clean and checkpoints; the audit
+                     then throws the perturbed state away, restores the
+                     checkpoint, and the run finishes 0 with a rollback
+                     event in history.jsonl.
+
+Usage: python _chaos_desync_worker.py <out_dir> <exit|rollback>
+"""
+
+import sys
+from functools import partial
+
+out_dir, mode = sys.argv[1], sys.argv[2]
+assert mode in ("exit", "rollback"), mode
+
+import dataclasses  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from tpuddp import nn, optim  # noqa: E402
+from tpuddp.data import ShardedDataLoader, SyntheticClassification  # noqa: E402
+from tpuddp.models import ToyMLP  # noqa: E402
+from tpuddp.parallel.ddp import DistributedDataParallel  # noqa: E402
+from tpuddp.parallel.mesh import data_mesh  # noqa: E402
+from tpuddp.parallel.spawn import run_ddp_training  # noqa: E402
+from tpuddp.training.loop import run_training_loop  # noqa: E402
+
+
+def perturb_one_device(mesh, params):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    host = np.asarray(leaves[0])
+    shards = []
+    for i, d in enumerate(mesh.devices.flat):
+        h = host.copy()
+        if i == 3 % mesh.devices.size:
+            h.flat[0] += 0.25
+        shards.append(jax.device_put(h, d))
+    bad = jax.make_array_from_single_device_arrays(
+        host.shape, NamedSharding(mesh, P()), shards
+    )
+    return jax.tree_util.tree_unflatten(treedef, [bad] + leaves[1:])
+
+
+def demo(rank, world_size, save_dir, optional_args, mode=None):
+    mesh = data_mesh(world_size)
+    train = ShardedDataLoader(
+        SyntheticClassification(n=64, shape=(8, 8, 3), seed=0),
+        batch_size=2, mesh=mesh, shuffle=True,
+    )
+    test = ShardedDataLoader(
+        SyntheticClassification(n=16, shape=(8, 8, 3), seed=1),
+        batch_size=2, mesh=mesh,
+    )
+    guard = {
+        "audit_every_n_epochs": 1,
+        "on_desync": "rollback" if mode == "rollback" else "exit",
+    }
+    ddp = DistributedDataParallel(
+        ToyMLP(hidden=(16,)), optim.Adam(1e-2), nn.CrossEntropyLoss(),
+        mesh=mesh, guard=guard,
+    )
+    state = ddp.init_state(jax.random.key(0), jnp.zeros((1, 8, 8, 3)))
+    start_epoch = 0
+    if mode == "rollback":
+        # epoch 0 trains clean and publishes ckpt_0 — the last-good state the
+        # rollback must land on
+        state, _ = run_training_loop(
+            ddp, state, train, test, save_dir, num_epochs=1, checkpoint_epoch=1,
+            scan_steps=2, per_replica_log=False,
+        )
+        start_epoch = 1
+    state = dataclasses.replace(state, params=perturb_one_device(mesh, state.params))
+    run_training_loop(
+        ddp, state, train, test, save_dir, num_epochs=3, checkpoint_epoch=1,
+        scan_steps=2, per_replica_log=False, start_epoch=start_epoch,
+    )
+
+
+run_ddp_training(
+    partial(demo, mode=mode),
+    world_size=4,
+    save_dir=out_dir,
+    optional_args={},
+    backend="cpu",
+)

@@ -6,8 +6,14 @@ live: SIGTERM drain handlers installed, ``TrainingPreempted`` -> exit 75,
 ``$TPUDDP_FAULT`` injection hooks armed, ``$TPUDDP_AUTO_RESUME`` resume.
 
 Usage: python _chaos_train_worker.py <out_dir> <num_epochs>
+
+``$TPUDDP_CHAOS_TRAINING`` may hold a JSON object of training-config
+overrides (e.g. ``{"guard": {"max_consecutive_skips": 0}}``) so chaos
+scenarios can arm the numerical guard without a worker per knob.
 """
 
+import json
+import os
 import sys
 from functools import partial
 
@@ -30,6 +36,7 @@ TRAINING = {
     "mode": "shard_map",
     "synthetic_n": (256, 64),  # 8 train batch groups per epoch
 }
+TRAINING.update(json.loads(os.environ.get("TPUDDP_CHAOS_TRAINING") or "{}"))
 
 run_ddp_training(
     partial(basic_ddp_training_loop, training=TRAINING),

@@ -51,3 +51,30 @@ def test_summary_without_baseline(tmp_path):
     bench.RESULTS.clear()
     summary = bench.emit_summary(10.0, None, out_path=str(tmp_path / "r.json"))
     assert summary["vs_baseline"] == 1.0  # torch missing -> neutral ratio
+
+
+def test_nonfinite_row_values_serialize_as_strict_json_null(tmp_path):
+    """ISSUE 3 satellite: a failed/blown-up config row (NaN/Inf values) must
+    land in bench_results.json as ``null`` — never the bare ``NaN`` token
+    Python's default json.dump emits, which strict parsers reject. Pinned as
+    a full round trip through a parser that refuses non-finite constants."""
+    bench.RESULTS.clear()
+    bench.RESULTS["exploded f32 (diverged)"] = {
+        "samples_per_sec_per_chip": float("nan"),
+        "ms_per_step": float("inf"),
+        "mfu": None,
+    }
+    out = tmp_path / "bench_results.json"
+    bench.emit_summary(123.0, 10.0, out_path=str(out))
+    raw = out.read_text()
+    assert "NaN" not in raw and "Infinity" not in raw
+
+    def reject(tok):
+        raise AssertionError(f"non-strict JSON token {tok!r} in bench_results.json")
+
+    payload = json.loads(raw, parse_constant=reject)
+    row = payload["configs"]["exploded f32 (diverged)"]
+    assert row["samples_per_sec_per_chip"] is None
+    assert row["ms_per_step"] is None
+    assert row["mfu"] is None
+    bench.RESULTS.clear()

@@ -1,11 +1,15 @@
-"""Chaos suite (ISSUE 1 acceptance): real subprocess kills and injected
-faults against the resilience layer.
+"""Chaos suite (ISSUE 1 + ISSUE 3 acceptance): real subprocess kills and
+injected faults against the resilience layer.
 
 Scenarios: an external SIGTERM mid-training drains into a valid emergency
 checkpoint and exit 75, auto-resume continues exactly where it left off; a
 corrupted newest checkpoint is skipped in favor of the previous good one; an
 injected ``hang@barrier`` dead peer is detected by the heartbeat watchdog
-within the configured timeout (exit 76) instead of hanging forever.
+within the configured timeout (exit 76) instead of hanging forever; an
+injected ``nan@step=N`` gradient is skipped by the numerical-guard firewall
+(state stays finite, run finishes 0); a single-replica parameter
+perturbation is caught by the desync auditor — exit 77, or a recorded
+rollback-to-last-good when ``on_desync="rollback"``.
 
 Marked ``chaos`` + ``slow``: run with ``tools/run_chaos.py`` or
 ``pytest -m chaos``; never part of the tier-1 fast path.
@@ -18,10 +22,12 @@ import subprocess
 import sys
 import time
 
+import numpy as np
 import pytest
 
 from tpuddp.resilience import integrity
 from tpuddp.resilience.preemption import (
+    EXIT_DESYNC,
     EXIT_INJECTED_CRASH,
     EXIT_PREEMPTED,
     EXIT_WATCHDOG,
@@ -33,13 +39,17 @@ pytestmark = [pytest.mark.chaos, pytest.mark.slow]
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRAIN_WORKER = os.path.join(REPO, "tests", "_chaos_train_worker.py")
 HANG_WORKER = os.path.join(REPO, "tests", "_chaos_hang_worker.py")
+DESYNC_WORKER = os.path.join(REPO, "tests", "_chaos_desync_worker.py")
 
 
 def chaos_env(**extra):
     env = dict(os.environ)
     # clean CPU-only children: no TPU plugin, no inherited fault/resume flags
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    for k in ("TPUDDP_FAULT", "TPUDDP_AUTO_RESUME", "TPUDDP_WATCHDOG_TIMEOUT"):
+    for k in (
+        "TPUDDP_FAULT", "TPUDDP_AUTO_RESUME", "TPUDDP_WATCHDOG_TIMEOUT",
+        "TPUDDP_CHAOS_TRAINING", "TPUDDP_DEBUG_NANS",
+    ):
         env.pop(k, None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -148,6 +158,80 @@ def test_corrupt_newest_checkpoint_falls_back_on_resume(tmp_path):
     # run redid it from the epoch-0 state
     assert history_epochs(tmp_path) == [0, 1, 1, 2, 3]
     assert integrity.verify_file(os.path.join(str(tmp_path), "ckpt_3.npz"))
+
+
+def test_nan_gradient_firewalled_end_to_end(tmp_path):
+    """ISSUE 3 chaos proof, firewall leg: a nan@step=N fault poisons one
+    train micro-batch's gradient mid-run; the guarded run must skip exactly
+    that update (recorded in history.jsonl), keep every later epoch finite,
+    and finish with exit 0 — the poisoned step never reaches the state."""
+    proc = run_train_worker(
+        tmp_path, epochs=4,
+        env=chaos_env(
+            TPUDDP_FAULT="nan@step=12",  # epoch 1 (8 batch groups/epoch)
+            TPUDDP_CHAOS_TRAINING=json.dumps({"guard": True}),
+        ),
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "nan@step=12 fired" in proc.stdout + proc.stderr
+    rows = [
+        json.loads(line)
+        for line in open(os.path.join(str(tmp_path), "history.jsonl"))
+    ]
+    assert [r["epoch"] for r in rows] == [0, 1, 2, 3]
+    by_epoch = {r["epoch"]: r for r in rows}
+    assert by_epoch[1]["skipped_steps_epoch"] == 1
+    assert by_epoch[0]["skipped_steps_epoch"] == 0
+    assert by_epoch[3]["skipped_steps"] == 1
+    # the poisoned epoch's row is a strict-JSON post-mortem (null, not NaN);
+    # every later epoch trains on finite numbers
+    assert by_epoch[1]["train_loss"] is None
+    for e in (2, 3):
+        assert by_epoch[e]["train_loss"] is not None
+        assert np.isfinite(by_epoch[e]["train_loss"])
+
+
+def test_desync_auditor_exits_77(tmp_path):
+    """ISSUE 3 chaos proof, auditor leg: one device's copy of a replicated
+    parameter is perturbed; the next epoch-boundary audit must name the
+    divergent leaf and exit EXIT_DESYNC (77)."""
+    proc = subprocess.run(
+        [sys.executable, "-u", DESYNC_WORKER, str(tmp_path), "exit"],
+        env=chaos_env(), cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == EXIT_DESYNC, (
+        f"exit {proc.returncode}:\n" + proc.stdout[-2000:] + proc.stderr[-2000:]
+    )
+    both = proc.stdout + proc.stderr
+    assert "cross-replica desync" in both
+    assert "bias" in both or "weight" in both  # the leaf is named
+    rows = [
+        json.loads(line)
+        for line in open(os.path.join(str(tmp_path), "history.jsonl"))
+    ]
+    assert any(r.get("event") == "desync" for r in rows)
+
+
+def test_desync_rollback_recovers_and_finishes(tmp_path):
+    """ISSUE 3 chaos proof, rollback leg: with on_desync="rollback" and an
+    intact epoch-0 checkpoint, the perturbed state is discarded, the run
+    restores last-good, redoes the epoch, and finishes with exit 0 and a
+    rollback event in history.jsonl."""
+    proc = subprocess.run(
+        [sys.executable, "-u", DESYNC_WORKER, str(tmp_path), "rollback"],
+        env=chaos_env(), cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, (
+        f"exit {proc.returncode}:\n" + proc.stdout[-2000:] + proc.stderr[-2000:]
+    )
+    assert "Guard rollback" in proc.stdout + proc.stderr
+    rows = [
+        json.loads(line)
+        for line in open(os.path.join(str(tmp_path), "history.jsonl"))
+    ]
+    events = [r for r in rows if r.get("event") == "rollback"]
+    assert events and events[0]["resume_epoch"] == 1
+    assert [r["epoch"] for r in rows if "train_loss" in r] == [0, 1, 2]
 
 
 def test_hang_at_barrier_detected_by_watchdog(tmp_path):

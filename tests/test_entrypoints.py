@@ -211,7 +211,9 @@ def test_accelerate_entrypoint_observability_parity(tmp_path, capsys, monkeypatc
     )
     with pytest.raises(FloatingPointError, match="train loss"):
         basic_accelerate_training(str(tmp_path / "nan"), training)
-    last = json.loads(
-        open(tmp_path / "nan" / "history.jsonl").read().splitlines()[-1]
-    )
-    assert last["epoch"] == 0 and last["train_loss"] != last["train_loss"]  # NaN
+    raw = open(tmp_path / "nan" / "history.jsonl").read()
+    # strict-JSON contract (ISSUE 3): the poisoned metric lands as null,
+    # never the bare NaN token strict parsers reject
+    assert "NaN" not in raw
+    last = json.loads(raw.splitlines()[-1])
+    assert last["epoch"] == 0 and last["train_loss"] is None

@@ -1,0 +1,708 @@
+"""Numerical guard (tpuddp/resilience/guard.py) — ISSUE 3 contracts.
+
+Pinned here:
+
+- config resolution: ``training.guard`` bool/dict forms, unknown-key refusal,
+  validation of the policy knobs;
+- the firewall: an injected non-finite gradient is a BITWISE no-op on
+  params / optimizer state / EF residual / module buffers, across
+  mode (shard_map, auto, managed) x comm hook (none, bf16, bf16_ef) x
+  clip_grad_norm x grad accumulation x weight-update sharding, with the
+  ``skipped_steps`` counters incrementing and ``consecutive`` resetting on
+  the next applied update;
+- clip-and-check compose on the f32 aggregated gradient before quantization:
+  guarded compressed training stays on the unguarded trajectory bit-for-bit
+  when nothing is skipped;
+- zero-cost-off: a guard-disabled build lowers to the IDENTICAL program as a
+  build that never heard of the guard, and guard-on adds no collectives to
+  the replicated step;
+- the desync auditor: agreement -> None, a single-device perturbation of a
+  replicated leaf -> that leaf's path (torch ``_verify_params_across_
+  processes`` semantics), wrap-time audit raises ReplicaDesync (exit 77
+  contract);
+- resume: ``skipped_steps`` and the bf16_ef residual survive a checkpoint
+  round trip (native and managed), and pre-guard checkpoints load into a
+  guarded template at zero;
+- the epoch driver: ``nan@step=N`` injection skips exactly one update, the
+  history row records it with strict-JSON null losses, and
+  ``max_consecutive_skips`` triggers rollback-to-last-good that redoes the
+  epoch from the restored state.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpuddp import nn, optim
+from tpuddp.data import ShardedDataLoader, SyntheticClassification
+from tpuddp.models import ToyCNN, ToyMLP
+from tpuddp.parallel import make_mesh
+from tpuddp.parallel.ddp import DistributedDataParallel
+from tpuddp.resilience import faults
+from tpuddp.resilience import guard as guard_lib
+from tpuddp.training import checkpoint as ckpt
+from tpuddp.training.loop import run_training_loop
+from tpuddp.training.step import stack_batches
+
+KEY = jax.random.key(0)
+
+
+def make_batch(n=32, seed=5, nan=False):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8, 8, 3).astype(np.float32)
+    if nan:
+        x[0, 0, 0, 0] = np.nan
+    y = rng.randint(0, 10, n).astype(np.int32)
+    return x, y, np.ones(n, np.float32)
+
+
+def build(mesh, guard=True, hook="none", mode="shard_map", wus=False,
+          accum=1, clip=None, model=None):
+    return DistributedDataParallel(
+        model if model is not None else ToyMLP(hidden=(16,)),
+        optim.Adam(1e-2),
+        nn.CrossEntropyLoss(),
+        mesh=mesh,
+        mode=mode,
+        comm_hook=hook,
+        weight_update_sharding=wus,
+        grad_accumulation=accum,
+        clip_grad_norm=clip,
+        guard=guard,
+    )
+
+
+def snapshot(state):
+    return jax.tree_util.tree_map(
+        np.asarray,
+        (state.params, state.opt_state, state.comm_state, state.model_state),
+    )
+
+
+def assert_bitwise_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+# ----------------------------------------------------------------- config --
+
+
+def test_resolve_guard_forms():
+    assert not guard_lib.resolve_guard(None).enabled
+    assert not guard_lib.resolve_guard(False).enabled
+    assert guard_lib.resolve_guard(True).enabled
+    cfg = guard_lib.resolve_guard({"max_consecutive_skips": 7, "on_desync": "rollback"})
+    assert cfg.enabled and cfg.max_consecutive_skips == 7
+    assert cfg.on_desync == "rollback"
+    assert guard_lib.resolve_guard(cfg) is cfg
+    assert guard_lib.resolve_guard({"enabled": False}).enabled is False
+
+
+def test_resolve_guard_refuses_bad_input():
+    with pytest.raises(ValueError, match="did you mean 'max_consecutive_skips'"):
+        guard_lib.resolve_guard({"max_consecutive_skip": 1})
+    with pytest.raises(ValueError, match="on_desync"):
+        guard_lib.resolve_guard({"on_desync": "panic"})
+    with pytest.raises(ValueError, match="max_consecutive_skips"):
+        guard_lib.resolve_guard({"max_consecutive_skips": -1})
+    with pytest.raises(ValueError, match="audit_every_n_epochs"):
+        guard_lib.resolve_guard({"audit_every_n_epochs": 0})
+    with pytest.raises(ValueError, match="bool or a mapping"):
+        guard_lib.resolve_guard("on")
+
+
+def test_nan_fault_spec_grammar():
+    specs = faults.parse_fault_specs("nan@step=5")
+    assert specs[0].kind == "nan" and specs[0].site == "step" and specs[0].arg == "5"
+    with pytest.raises(ValueError, match="nan"):
+        faults.parse_fault_specs("crash@step=5")
+    with pytest.raises(ValueError, match="nan"):
+        faults.parse_fault_specs("nan@epoch=5")
+
+
+# --------------------------------------------------------------- firewall --
+
+
+@pytest.mark.parametrize("mode", ["shard_map", "auto"])
+@pytest.mark.parametrize("hook", ["none", "bf16", "bf16_ef"])
+@pytest.mark.parametrize("clip", [None, 1.0])
+def test_firewall_skips_bitwise(cpu_devices, mode, hook, clip):
+    """The acceptance matrix: a non-finite gradient leaves params, optimizer
+    moments, and the EF residual bitwise untouched, counts the skip, and the
+    next finite step trains and resets ``consecutive``."""
+    mesh = make_mesh(cpu_devices)
+    ddp = build(mesh, hook=hook, mode=mode, clip=clip)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    good, bad = make_batch(), make_batch(nan=True)
+    st, _ = ddp.train_step(st, ddp.shard(good))
+    before = snapshot(st)
+    st, _ = ddp.train_step(st, ddp.shard(bad))
+    assert_bitwise_equal(before, snapshot(st))
+    assert guard_lib.read_skip_counters(st) == (1, 1)
+    st, m = ddp.train_step(st, ddp.shard(good))
+    assert guard_lib.read_skip_counters(st) == (1, 0)
+    assert np.isfinite(float(np.sum(np.asarray(m["loss_sum"]))))
+    changed = any(
+        not np.array_equal(a, b)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(before[0]),
+            jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(np.asarray, st.params)
+            ),
+        )
+    )
+    assert changed, "a finite step after a skip must still train"
+
+
+def test_firewall_with_wus_and_clip(cpu_devices):
+    """Composition corner: weight-update sharding (collectives inside the
+    cond branch) x bf16_ef x clip — the skip must also preserve the sharded
+    optimizer moments and the per-replica residual."""
+    mesh = make_mesh(cpu_devices)
+    ddp = build(mesh, hook="bf16_ef", wus=True, clip=0.5)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    good, bad = make_batch(), make_batch(nan=True)
+    st, _ = ddp.train_step(st, ddp.shard(good))
+    assert np.any(np.asarray(st.comm_state) != 0)  # EF residual is live
+    before = snapshot(st)
+    st, _ = ddp.train_step(st, ddp.shard(bad))
+    assert_bitwise_equal(before, snapshot(st))
+    assert guard_lib.read_skip_counters(st) == (1, 1)
+
+
+def test_firewall_skips_whole_accumulation_cycle(cpu_devices):
+    """grad_accumulation: one poisoned micro-batch inside a cycle poisons the
+    cycle's aggregated gradient — the ONE update of that cycle is skipped
+    bitwise; clean cycles in the same dispatch still apply."""
+    mesh = make_mesh(cpu_devices)
+    ddp = build(mesh, hook="bf16_ef", accum=2, clip=1.0)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    good, bad = make_batch(), make_batch(nan=True)
+    st, _ = ddp.train_step_many(st, ddp.shard_stacked(stack_batches([good, good])))
+    before = snapshot(st)
+    # dispatch of 2 cycles: [bad, good] skipped, [good, good] applied
+    st, _ = ddp.train_step_many(
+        st, ddp.shard_stacked(stack_batches([bad, good, good, good]))
+    )
+    assert guard_lib.read_skip_counters(st) == (1, 0)
+    changed = any(
+        not np.array_equal(a, b)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(before[0]),
+            jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(np.asarray, st.params)
+            ),
+        )
+    )
+    assert changed  # the second (clean) cycle applied
+    # an all-poisoned dispatch is a full bitwise no-op
+    before = snapshot(st)
+    st, _ = ddp.train_step_many(
+        st, ddp.shard_stacked(stack_batches([bad, good]))
+    )
+    assert_bitwise_equal(before, snapshot(st))
+    assert guard_lib.read_skip_counters(st) == (2, 1)
+
+
+def test_firewall_reverts_batchnorm_buffers(cpu_devices):
+    """The no-op extends to module buffers: BN running stats computed from
+    the poisoned forward must not outlive the skipped update."""
+    mesh = make_mesh(cpu_devices)
+    model = ToyCNN(num_classes=10, widths=(4,), sync_bn=True)
+    nn.convert_sync_batchnorm(model)
+    ddp = build(mesh, model=model)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    good, bad = make_batch(), make_batch(nan=True)
+    st, _ = ddp.train_step(st, ddp.shard(good))
+    before = snapshot(st)
+    st, _ = ddp.train_step(st, ddp.shard(bad))
+    assert_bitwise_equal(before, snapshot(st))  # model_state included
+    assert guard_lib.read_skip_counters(st) == (1, 1)
+
+
+def test_guarded_compressed_training_matches_unguarded(cpu_devices):
+    """Clip-and-check happen on the f32 aggregated gradient BEFORE
+    quantization: on an all-finite stream the guarded bf16_ef+clip run is
+    bit-identical to the unguarded one — the guard only observes."""
+    mesh = make_mesh(cpu_devices)
+
+    def run(guard):
+        ddp = build(mesh, guard=guard, hook="bf16_ef", clip=1.0)
+        st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        for seed in range(4):
+            st, _ = ddp.train_step(st, ddp.shard(make_batch(seed=seed)))
+        return st
+
+    a, b = run(True), run(False)
+    assert_bitwise_equal(
+        (a.params, a.opt_state, a.comm_state), (b.params, b.opt_state, b.comm_state)
+    )
+    assert guard_lib.read_skip_counters(a) == (0, 0)
+
+
+# ------------------------------------------------------------ zero-cost-off --
+
+
+def _lowered_step_text(ddp, st, batch):
+    return jax.jit(lambda s, b: ddp.train_step(s, b)).lower(st, batch).as_text()
+
+
+def test_guard_off_lowers_to_identical_program(cpu_devices):
+    """training.guard off is a strict no-op: same lowered program as a build
+    that never passed the knob — no extra collectives, no reshapes, nothing."""
+    mesh = make_mesh(cpu_devices)
+    batch = make_batch()
+
+    def lower(guard):
+        ddp = build(mesh, guard=guard, hook="bf16", clip=1.0)
+        st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        return _lowered_step_text(ddp, st, ddp.shard(batch))
+
+    assert lower(None) == lower({"enabled": False}) == lower(False)
+
+
+def test_guard_on_adds_no_collectives_to_replicated_step(cpu_devices):
+    """The happy-path cost model: on the replicated (non-wus) step the
+    verdict is a replica-local reduction over the post-allreduce gradient —
+    guard-on and guard-off programs carry the same collective count."""
+    mesh = make_mesh(cpu_devices)
+    batch = make_batch()
+
+    def collectives(guard):
+        ddp = build(mesh, guard=guard)
+        st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        txt = _lowered_step_text(ddp, st, ddp.shard(batch))
+        return sum(txt.count(op) for op in (
+            "stablehlo.all_reduce", "stablehlo.reduce_scatter",
+            "stablehlo.all_gather", "stablehlo.collective_permute",
+        ))
+
+    assert collectives(True) == collectives(None)
+
+
+def test_guard_on_no_recompilation_across_calls(cpu_devices):
+    """Epoch cadence: repeated guarded steps reuse one compiled program (the
+    counters are carried state, not a new shape per epoch)."""
+    mesh = make_mesh(cpu_devices)
+    ddp = build(mesh)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    st, _ = ddp.train_step(st, ddp.shard(make_batch()))  # build + compile
+    jitted = ddp._train_step  # the cached compiled closure
+    for seed in range(3):
+        st, _ = ddp.train_step(st, ddp.shard(make_batch(seed=seed)))
+    assert ddp._train_step is jitted
+
+
+# ----------------------------------------------------------------- auditor --
+
+
+def _perturb_one_device(mesh, params, device_idx=3, delta=0.25):
+    """A desynced world: one device's copy of the first leaf differs."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    host = np.asarray(leaves[0])
+    shards = []
+    for i, d in enumerate(mesh.devices.flat):
+        h = host.copy()
+        if i == device_idx:
+            h.flat[0] += delta
+        shards.append(jax.device_put(h, d))
+    bad = jax.make_array_from_single_device_arrays(
+        host.shape, NamedSharding(mesh, P()), shards
+    )
+    return jax.tree_util.tree_unflatten(treedef, [bad] + leaves[1:])
+
+
+def test_auditor_accepts_synced_and_names_divergent_leaf(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    ddp = build(mesh)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    assert guard_lib.audit_params(mesh, st.params) is None
+    bad = _perturb_one_device(mesh, st.params)
+    leaf = guard_lib.audit_params(mesh, bad)
+    assert leaf is not None
+    flat = jax.tree_util.tree_flatten_with_path(st.params)[0]
+    assert leaf == jax.tree_util.keystr(flat[0][0])  # names the FIRST leaf
+    with pytest.raises(guard_lib.ReplicaDesync, match="exit 77"):
+        guard_lib.audit_or_raise(mesh, bad, where="test")
+
+
+def test_auditor_flags_nonfinite_params(cpu_devices):
+    """All-replica-identical NaN params are still flagged: never a state
+    worth training on, and pmax - pmin of NaN is NaN, not 0."""
+    mesh = make_mesh(cpu_devices)
+    ddp = build(mesh)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    leaves, treedef = jax.tree_util.tree_flatten(st.params)
+    poisoned = jnp.asarray(np.asarray(leaves[0]) * np.nan)
+    bad = jax.tree_util.tree_unflatten(treedef, [poisoned] + leaves[1:])
+    assert guard_lib.audit_params(mesh, bad) is not None
+
+
+def test_exit_desync_registered():
+    from tpuddp.resilience import EXIT_DESYNC, EXIT_PREEMPTED, EXIT_WATCHDOG
+
+    assert EXIT_DESYNC == 77
+    assert len({EXIT_DESYNC, EXIT_PREEMPTED, EXIT_WATCHDOG}) == 3
+
+
+# ------------------------------------------------------------------ resume --
+
+
+def test_skip_counters_and_residual_survive_checkpoint(cpu_devices, tmp_path):
+    """The resume contract: skipped_steps and the EF residual round-trip
+    through the native checkpoint and the restored state keeps training with
+    the counters intact."""
+    mesh = make_mesh(cpu_devices)
+    ddp = build(mesh, hook="bf16_ef")
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    st, _ = ddp.train_step(st, ddp.shard(make_batch()))
+    st, _ = ddp.train_step(st, ddp.shard(make_batch(nan=True)))
+    assert guard_lib.read_skip_counters(st) == (1, 1)
+    res = np.asarray(st.comm_state)
+    path = ckpt.save(str(tmp_path / "ckpt_1.npz"), st)
+
+    ddp2 = build(mesh, hook="bf16_ef")
+    st2 = ddp2.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    restored = ckpt.load(path, st2)
+    assert guard_lib.read_skip_counters(restored) == (1, 1)
+    np.testing.assert_array_equal(np.asarray(restored.comm_state), res)
+    st3, _ = ddp2.train_step(restored, ddp2.shard(make_batch()))
+    assert guard_lib.read_skip_counters(st3) == (1, 0)
+
+
+def test_pre_guard_checkpoint_loads_into_guarded_template(cpu_devices, tmp_path):
+    """Turning the guard ON over checkpoints from an unguarded run must
+    resume with zeroed counters, not crash on the missing leaves."""
+    mesh = make_mesh(cpu_devices)
+    plain = build(mesh, guard=False)
+    st = plain.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    path = ckpt.save(str(tmp_path / "ckpt_1.npz"), st)  # no skipped_steps leaves
+    with np.load(path) as data:
+        assert not any("skipped_steps" in k for k in data.files)
+    guarded = build(mesh, guard=True)
+    st2 = guarded.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    restored = ckpt.load(path, st2)
+    assert guard_lib.read_skip_counters(restored) == (0, 0)
+    st3, _ = guarded.train_step(restored, guarded.shard(make_batch(nan=True)))
+    assert guard_lib.read_skip_counters(st3) == (1, 1)
+
+
+def test_managed_accumulation_skip_reverts_buffers(cpu_devices):
+    """The managed grad-accumulation path commits model_state eagerly per
+    micro-batch (grad-only programs), so the guard's skip branch must hand
+    the PRE-cycle buffers back — a poisoned cycle's BatchNorm running stats
+    must not outlive the skipped update (the wedge where every later
+    forward emits NaN)."""
+    from tpuddp.accelerate import Accelerator
+
+    mesh = make_mesh(cpu_devices)
+    model_def = ToyCNN(num_classes=10, widths=(4,), sync_bn=False)
+    x, y, w = make_batch()
+    xb, yb, wb = make_batch(nan=True)
+    criterion = nn.CrossEntropyLoss()
+    acc = Accelerator(
+        mesh=mesh, seed=0, guard=True, gradient_accumulation_steps=2
+    )
+    model, opt = acc.prepare(model_def, optim.Adam(1e-2))
+
+    def cycle(batches):
+        for bx, by, bw in batches:
+            loss = criterion(model(bx), by, bw)
+            acc.backward(loss)
+            opt.step()
+
+    cycle([(x, y, w), (x, y, w)])  # clean cycle
+    before = jax.tree_util.tree_map(
+        np.asarray, (model._params, model._model_state, opt.opt_state)
+    )
+    cycle([(xb, yb, wb), (x, y, w)])  # poisoned first micro-batch
+    after = jax.tree_util.tree_map(
+        np.asarray, (model._params, model._model_state, opt.opt_state)
+    )
+    assert_bitwise_equal(before, after)  # buffers included
+    assert opt.skip_counters() == (1, 1)
+    cycle([(x, y, w), (x, y, w)])  # recovers: finite forward, counters reset
+    assert opt.skip_counters() == (1, 0)
+    ev = criterion(model.eval()(x), y, w)
+    assert np.isfinite(float(ev.item()))
+
+
+def test_managed_guard_state_roundtrip(cpu_devices, tmp_path):
+    """save_state/load_state carry the managed skip counters with the rest
+    of the lossless state."""
+    from tpuddp.accelerate import Accelerator
+
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    xb, yb, wb = make_batch(nan=True)
+    criterion = nn.CrossEntropyLoss()
+    acc = Accelerator(mesh=mesh, seed=3, guard=True, comm_hook="bf16_ef")
+    model, opt = acc.prepare(ToyMLP(hidden=(16,)), optim.Adam(1e-2))
+    for bx, by, bw in ((x, y, w), (xb, yb, wb)):
+        loss = criterion(model(bx), by, bw)
+        acc.backward(loss)
+        opt.step()
+    assert opt.skip_counters() == (1, 1)
+    acc.save_state(model, opt, str(tmp_path), epoch=0)
+
+    acc2 = Accelerator(mesh=mesh, seed=3, guard=True, comm_hook="bf16_ef")
+    model2, opt2 = acc2.prepare(ToyMLP(hidden=(16,)), optim.Adam(1e-2))
+    model2(x[:1])
+    assert acc2.load_state(model2, opt2, str(tmp_path)) == 1
+    assert opt2.skip_counters() == (1, 1)
+    loss = criterion(model2(x), y, w)
+    acc2.backward(loss)
+    opt2.step()
+    assert opt2.skip_counters() == (1, 0)
+
+
+# ------------------------------------------------------------ epoch driver --
+
+
+def _loaders(mesh, n_train=64, batch=2):
+    train = ShardedDataLoader(
+        SyntheticClassification(n=n_train, shape=(8, 8, 3), seed=0),
+        batch_size=batch, mesh=mesh, shuffle=True,
+    )
+    test = ShardedDataLoader(
+        SyntheticClassification(n=16, shape=(8, 8, 3), seed=1),
+        batch_size=batch, mesh=mesh,
+    )
+    return train, test
+
+
+def test_loop_nan_injection_skips_and_records(cpu_devices, tmp_path, monkeypatch):
+    """nan@step=N end to end through the epoch driver: exactly one skipped
+    update, the epoch's history row carries the skip counters with
+    strict-JSON null losses, later epochs are finite, and the final params
+    are finite."""
+    monkeypatch.setenv("TPUDDP_FAULT", "nan@step=3")
+    faults.reload_faults()
+    try:
+        mesh = make_mesh(cpu_devices)
+        train, test = _loaders(mesh)
+        ddp = build(mesh, guard={"audit_every_n_epochs": 1})
+        st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        st, hist = run_training_loop(
+            ddp, st, train, test, str(tmp_path), num_epochs=2,
+            checkpoint_epoch=1, scan_steps=2, per_replica_log=False,
+            log=lambda *a: None,
+        )
+        lines = [
+            json.loads(l) for l in open(os.path.join(str(tmp_path), "history.jsonl"))
+        ]
+        rows = [l for l in lines if "train_loss" in l]
+        assert rows[0]["skipped_steps"] == 1
+        assert rows[0]["skipped_steps_epoch"] == 1
+        assert rows[0]["train_loss"] is None  # NaN -> null, strict JSON
+        assert rows[1]["skipped_steps_epoch"] == 0
+        assert rows[1]["train_loss"] is not None
+        assert all(
+            np.all(np.isfinite(l)) for l in jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(np.asarray, st.params)
+            )
+        )
+    finally:
+        faults.reload_faults()
+
+
+def test_loop_rolls_back_to_last_good(cpu_devices, tmp_path, monkeypatch):
+    """max_consecutive_skips exceeded at an epoch boundary: the driver
+    restores the newest intact checkpoint, records the rollback event in
+    history.jsonl, redoes the epoch (set_epoch re-derives its data order),
+    and finishes clean once the fault does not recur."""
+    # 4 batches/epoch at scan_steps=2: step 7 is epoch 1's LAST update, so
+    # `consecutive` is still 1 when the driver reads the counters
+    monkeypatch.setenv("TPUDDP_FAULT", "nan@step=7")
+    faults.reload_faults()
+    try:
+        mesh = make_mesh(cpu_devices)
+        train, test = _loaders(mesh)
+        ddp = build(mesh, guard={"max_consecutive_skips": 0})
+        st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        msgs = []
+        st, hist = run_training_loop(
+            ddp, st, train, test, str(tmp_path), num_epochs=3,
+            checkpoint_epoch=1, scan_steps=2, per_replica_log=False,
+            log=msgs.append,
+        )
+        lines = [
+            json.loads(l) for l in open(os.path.join(str(tmp_path), "history.jsonl"))
+        ]
+        events = [l for l in lines if l.get("event") == "rollback"]
+        assert events and events[0]["epoch"] == 1 and events[0]["resume_epoch"] == 1
+        assert [l["epoch"] for l in lines if "train_loss" in l] == [0, 1, 1, 2]
+        assert any("Guard rollback" in m for m in msgs)
+    finally:
+        faults.reload_faults()
+
+
+def test_loop_rollback_without_checkpoint_raises(cpu_devices, monkeypatch):
+    """No save_dir -> nothing to roll back to: the overflow surfaces as a
+    FloatingPointError instead of looping on a poisoned trajectory."""
+    monkeypatch.setenv("TPUDDP_FAULT", "nan@step=3")
+    faults.reload_faults()
+    try:
+        mesh = make_mesh(cpu_devices)
+        train, test = _loaders(mesh, n_train=8)  # 1 batch/epoch: skip IS the epoch
+        ddp = build(mesh, guard={"max_consecutive_skips": 0})
+        st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        # steps 0..2 are epochs 0-2 (finite); step 3 poisons epoch 3's only update
+        with pytest.raises(FloatingPointError, match="no checkpoint"):
+            run_training_loop(
+                ddp, st, train, test, None, num_epochs=6, checkpoint_epoch=1,
+                scan_steps=1, per_replica_log=False, log=lambda *a: None,
+            )
+    finally:
+        faults.reload_faults()
+
+
+def test_loop_periodic_audit_trips_on_desync(cpu_devices, tmp_path):
+    """audit_every_n_epochs: a single-replica perturbation injected between
+    epochs is caught at the next epoch-start audit and raises ReplicaDesync
+    (on_desync="exit"), with the divergence event recorded."""
+    mesh = make_mesh(cpu_devices)
+    train, test = _loaders(mesh)
+    ddp = build(mesh, guard={"audit_every_n_epochs": 1})
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    st = __import__("dataclasses").replace(
+        st, params=_perturb_one_device(mesh, st.params)
+    )
+    with pytest.raises(guard_lib.ReplicaDesync, match="audit"):
+        run_training_loop(
+            ddp, st, train, test, str(tmp_path), num_epochs=2,
+            checkpoint_epoch=1, scan_steps=2, per_replica_log=False,
+            log=lambda *a: None,
+        )
+    lines = [
+        json.loads(l) for l in open(os.path.join(str(tmp_path), "history.jsonl"))
+    ]
+    assert any(l.get("event") == "desync" for l in lines)
+
+
+def test_loop_desync_rollback_recovers(cpu_devices, tmp_path):
+    """on_desync="rollback": with an intact checkpoint on disk, the desynced
+    state is thrown away, the run restores and completes clean."""
+    mesh = make_mesh(cpu_devices)
+    train, test = _loaders(mesh)
+    ddp = build(mesh, guard={"audit_every_n_epochs": 1, "on_desync": "rollback"})
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    # epoch 0 trains clean and checkpoints; then we desync and resume at 1
+    st, _ = run_training_loop(
+        ddp, st, train, test, str(tmp_path), num_epochs=1, checkpoint_epoch=1,
+        scan_steps=2, per_replica_log=False, log=lambda *a: None,
+    )
+    bad = __import__("dataclasses").replace(
+        st, params=_perturb_one_device(mesh, st.params)
+    )
+    st2, _ = run_training_loop(
+        ddp, bad, train, test, str(tmp_path), num_epochs=3, checkpoint_epoch=1,
+        scan_steps=2, per_replica_log=False, start_epoch=1, log=lambda *a: None,
+    )
+    lines = [
+        json.loads(l) for l in open(os.path.join(str(tmp_path), "history.jsonl"))
+    ]
+    assert any(l.get("event") == "rollback" for l in lines)
+    assert guard_lib.audit_params(mesh, st2.params) is None  # resynced
+    assert [l["epoch"] for l in lines if "train_loss" in l] == [0, 1, 2]
+
+
+def test_managed_loop_rolls_back_to_last_good(cpu_devices, tmp_path):
+    """The managed epoch driver honors the same rollback policy as the
+    native one: a fully-poisoned epoch (every update skipped, consecutive
+    run over the limit) restores the newest state_{epoch}.npz via
+    load_state, records the rollback, redoes the epoch, and finishes clean
+    — never exit 0 with silently frozen weights."""
+    import train_accelerate as ta
+    from tpuddp.accelerate import Accelerator
+    from tpuddp.data import DataLoader
+
+    mesh = make_mesh(cpu_devices)
+    ds = SyntheticClassification(n=32, shape=(8, 8, 3), seed=0)  # float32
+    test_ds = SyntheticClassification(n=8, shape=(8, 8, 3), seed=1)
+    clean = ds.images.copy()
+    acc = Accelerator(mesh=mesh, seed=0, guard={"max_consecutive_skips": 0})
+    model, opt, loader = acc.prepare(
+        ToyMLP(hidden=(16,)), optim.Adam(1e-2), DataLoader(ds, batch_size=8)
+    )
+
+    class PoisonEpochOnce:
+        """Wrapper loader: the FIRST time epoch 1 starts, every sample goes
+        NaN (the whole epoch's updates skip); the redo sees clean data."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.fired = False
+
+        def set_epoch(self, e):
+            self.inner.set_epoch(e)
+            if e == 1 and not self.fired:
+                self.fired = True
+                ds.images[:] = np.nan
+            else:
+                ds.images[:] = clean
+
+        def __len__(self):
+            return len(self.inner)
+
+        def __iter__(self):
+            return iter(self.inner)
+
+    augment = jax.jit(lambda rng, i, x: x)
+    transform = jax.jit(lambda x: x)
+    ta.run_training_loop(
+        model, PoisonEpochOnce(loader), DataLoader(test_ds, batch_size=8),
+        nn.CrossEntropyLoss(), opt, str(tmp_path), acc, augment, transform,
+        num_epochs=3, checkpoint_epoch=1,
+    )
+    rows = [
+        json.loads(l) for l in open(os.path.join(str(tmp_path), "history.jsonl"))
+    ]
+    events = [r for r in rows if r.get("event") == "rollback"]
+    assert events and events[0]["epoch"] == 1 and events[0]["resume_epoch"] == 1
+    assert [r["epoch"] for r in rows if "train_loss" in r] == [0, 1, 1, 2]
+    assert opt.skip_counters()[1] == 0  # the redo applied real updates
+    leaves = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, model.params)
+    )
+    assert all(np.all(np.isfinite(l)) for l in leaves)
+
+
+def test_history_jsonl_is_strict_json(cpu_devices, tmp_path):
+    """Satellite: the empty-test-loader path writes NaN test metrics —
+    history.jsonl must still be strict JSON (null), and every line must
+    round-trip through a parser that refuses NaN tokens."""
+    mesh = make_mesh(cpu_devices)
+    train = ShardedDataLoader(
+        SyntheticClassification(n=16, shape=(8, 8, 3), seed=0),
+        batch_size=2, mesh=mesh, shuffle=True,
+    )
+    empty = ShardedDataLoader(
+        SyntheticClassification(n=0, shape=(8, 8, 3), seed=1),
+        batch_size=2, mesh=mesh,
+    )
+    ddp = build(mesh, guard=False)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    run_training_loop(
+        ddp, st, train, empty, str(tmp_path), num_epochs=1, checkpoint_epoch=5,
+        scan_steps=1, per_replica_log=False, log=lambda *a: None,
+    )
+    raw = open(os.path.join(str(tmp_path), "history.jsonl")).read()
+    assert "NaN" not in raw and "Infinity" not in raw
+
+    def reject_nan(tok):
+        raise AssertionError(f"non-strict token {tok!r} in history.jsonl")
+
+    rows = [
+        json.loads(line, parse_constant=reject_nan)
+        for line in raw.splitlines()
+    ]
+    assert rows[0]["test_loss"] is None and rows[0]["test_accuracy"] is None
+    assert np.isfinite(rows[0]["train_loss"])

@@ -17,7 +17,7 @@ from tpuddp.parallel import make_mesh
 from tpuddp.parallel.ddp import DistributedDataParallel
 from tpuddp.training import checkpoint as ckpt
 from tpuddp.training.loop import run_training_loop
-from tpuddp.utils.observability import MetricsWriter, check_finite
+from tpuddp.utils.observability import MetricsWriter, check_finite, json_sanitize
 
 
 def small_run(mesh, save_dir, num_epochs=2, start_epoch=0, state=None):
@@ -77,6 +77,39 @@ def test_metrics_writer_none_dir_is_noop():
     w = MetricsWriter(None)
     w.write({"a": 1})  # no crash, nothing written
     assert w.path is None
+
+
+def test_json_sanitize_nonfinite_to_null():
+    """Strict-JSON contract (ISSUE 3 satellite): non-finite floats become
+    None recursively; finite values and non-float types pass through."""
+    rec = {
+        "a": math.nan,
+        "b": math.inf,
+        "c": -math.inf,
+        "d": 1.5,
+        "e": "nan",  # strings are never touched
+        "f": [math.nan, 2, {"g": math.inf}],
+        "h": None,
+        "i": 3,
+    }
+    out = json_sanitize(rec)
+    assert out["a"] is None and out["b"] is None and out["c"] is None
+    assert out["d"] == 1.5 and out["e"] == "nan" and out["i"] == 3
+    assert out["f"] == [None, 2, {"g": None}]
+    # and the sanitized record survives the strictest dumps
+    json.dumps(out, allow_nan=False)
+
+
+def test_metrics_writer_emits_null_not_nan(tmp_path, monkeypatch):
+    """history.jsonl stays parseable by strict JSON consumers even when an
+    epoch's metrics blew up."""
+    w = MetricsWriter(str(tmp_path))
+    w.write({"epoch": 0, "train_loss": math.nan, "test_loss": math.inf})
+    w.close()
+    raw = open(os.path.join(str(tmp_path), "history.jsonl")).read()
+    assert "NaN" not in raw and "Infinity" not in raw
+    row = json.loads(raw, parse_constant=lambda t: pytest.fail(f"bare {t}"))
+    assert row["train_loss"] is None and row["test_loss"] is None
 
 
 def test_profiler_env_toggle(monkeypatch, tmp_path, mesh):

@@ -3,8 +3,10 @@
 
 The chaos tier lives outside the tier-1 fast path (every chaos test is also
 marked slow): it kills subprocess training runs with SIGTERM, injects
-``$TPUDDP_FAULT`` crashes/hangs/corruption, and asserts the exit-code and
-auto-resume contracts documented in README "Fault tolerance".
+``$TPUDDP_FAULT`` crashes/hangs/corruption/NaN-gradients (``nan@step=N``
+exercises the numerical-guard firewall end to end), drives the desync
+auditor's exit-77 and rollback-to-last-good paths, and asserts the
+exit-code and auto-resume contracts documented in README "Fault tolerance".
 
 Usage: python tools/run_chaos.py [extra pytest args]
 """

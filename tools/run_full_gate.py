@@ -11,6 +11,12 @@ every collected test, both tiers — and inherits pytest's exit-code contract
 (non-zero on failures, 4/5 if the expression ever selects nothing, i.e. the
 two-tier contract itself drifted).
 
+Tier membership note: the numerical-guard/desync suite (tests/test_guard.py)
+is deliberately UNMARKED so it rides in tier-1 — the firewall/auditor
+contracts are fast compiled-step assertions, not subprocess chaos; only the
+subprocess proofs (nan@step, exit-77, rollback in tests/test_chaos.py) live
+in the chaos tier.
+
 Usage: python tools/run_full_gate.py [extra pytest args]
 
 The two-tier contract is documented in README "Testing"; the chaos tier can

@@ -44,6 +44,7 @@ from tpuddp.nn.core import Context, Module
 from tpuddp.parallel import collectives as col
 from tpuddp.parallel import comm as comm_lib
 from tpuddp.parallel.mesh import data_mesh, replicate, shard_batch
+from tpuddp.resilience import guard as guard_lib
 from tpuddp.training import checkpoint as ckpt
 
 
@@ -497,6 +498,11 @@ class PreparedModel:
         self._fwd = {}
         self._pending = None  # (x, y, w, criterion, step_idx, LazyLoss)
         self._pending_grads = None
+        # model_state as of BEFORE the last grad-only forward: the guard's
+        # skip branch reverts to it so a poisoned forward's BatchNorm stats
+        # never outlive a skipped update (grad-only programs commit
+        # _model_state eagerly, unlike the fused step whose cond owns it)
+        self._mstate_before = None
         self._ones = {}  # cached sharded all-ones weight vectors by length
         self._bwd_key = accelerator._next_key()  # base key; fold_in(step) per batch
         self._bwd_counter = 0
@@ -545,6 +551,10 @@ class PreparedModel:
     def model_state(self, value):
         self._model_state = value
 
+    def _guard_enabled(self) -> bool:
+        g = getattr(self.accelerator, "guard", None)
+        return bool(g is not None and g.enabled)
+
     def _ensure_init(self, x):
         if self._params is not None:  # backing field: must not flush the queue
             return
@@ -564,6 +574,13 @@ class PreparedModel:
         self.params, self.model_state = replicate(
             self.accelerator.mesh, (params, mstate)
         )
+        if self._guard_enabled():
+            # prepare-time desync audit (the managed analog of the DDP
+            # wrap-time verify): every replica's copy of the just-placed
+            # parameters must fingerprint identically before the first step
+            guard_lib.audit_or_raise(
+                self.accelerator.mesh, self._params, where="accelerator-prepare"
+            )
 
     def __call__(self, x) -> LazyForward:
         self._ensure_init(x)
@@ -701,6 +718,7 @@ class PreparedModel:
         loss, grads, new_mstate = fn(
             self._params, self._model_state, self._bwd_key, step_idx, xb, yb, wb
         )
+        self._mstate_before = self._model_state
         self._model_state = new_mstate
         self._pending_grads = grads
         self._pending = None
@@ -713,8 +731,12 @@ class PreparedModel:
         key = (criterion, optimizer)
         if self._fused_step is None or self._fused_step[0] != key:
             hook = self._comm_hook_name()
+            guard_on = self._guard_enabled()
 
-            def fused(params, mstate, opt_state, comm_state, base_rng, step_idx, x, y, w):
+            def fused(
+                params, mstate, opt_state, comm_state, skipped, base_rng,
+                step_idx, x, y, w,
+            ):
                 rng = jax.random.fold_in(base_rng, step_idx)
 
                 def loss_fn(p):
@@ -729,16 +751,32 @@ class PreparedModel:
                 (loss, new_mstate), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
                 )(params)
-                # comm hook (managed emulation, parallel/comm.py): quantize
-                # the aggregated gradient through the wire dtype with error
-                # feedback BEFORE the clip, matching the native step's
-                # reduce-then-clip order
-                grads, comm_state = comm_lib.local_quantize(
-                    grads, comm_state, hook
+
+                def apply_all():
+                    # comm hook (managed emulation, parallel/comm.py):
+                    # quantize the aggregated gradient through the wire dtype
+                    # with error feedback BEFORE the clip, matching the
+                    # native step's reduce-then-clip order
+                    g, cs = comm_lib.local_quantize(grads, comm_state, hook)
+                    g = self._maybe_clip(g)
+                    new_params, new_opt = optimizer.update(g, opt_state, params)
+                    return new_params, new_mstate, new_opt, cs
+
+                if not guard_on:
+                    new_params, out_mstate, new_opt, cs = apply_all()
+                    return loss, new_params, out_mstate, new_opt, cs, skipped
+                # firewall (resilience/guard.py): the grads here ARE the
+                # XLA-aggregated global-batch f32 gradient — checked before
+                # quantization; a non-finite step is a bitwise no-op on
+                # params / opt-state / EF-residual / module buffers
+                ok = guard_lib.tree_all_finite(grads)
+                new_params, out_mstate, new_opt, cs, new_skipped = jax.lax.cond(
+                    ok,
+                    lambda: apply_all() + (guard_lib.reset_consecutive(skipped),),
+                    lambda: (params, mstate, opt_state, comm_state,
+                             guard_lib.bump_skip_counters(skipped)),
                 )
-                grads = self._maybe_clip(grads)
-                new_params, new_opt = optimizer.update(grads, opt_state, params)
-                return loss, new_params, new_mstate, new_opt, comm_state
+                return loss, new_params, out_mstate, new_opt, cs, new_skipped
 
             self._fused_step = (
                 key,
@@ -755,9 +793,11 @@ class PreparedModel:
         key = (criterion, optimizer, k)
         if key not in self._fused_scans:
             hook = self._comm_hook_name()
+            guard_on = self._guard_enabled()
 
             def fused_scan(
-                params, mstate, opt_state, comm_state, base_rng, idxs, xs, ys, ws
+                params, mstate, opt_state, comm_state, skipped, base_rng,
+                idxs, xs, ys, ws,
             ):
                 stacked = (
                     idxs,
@@ -767,7 +807,7 @@ class PreparedModel:
                 )
 
                 def body(carry, inp):
-                    p, ms, os_, cs = carry
+                    p, ms, os_, cs, sk = carry
                     idx, x, y, w = inp
                     rng = jax.random.fold_in(base_rng, idx)
 
@@ -781,18 +821,35 @@ class PreparedModel:
                     (loss, new_ms), grads = jax.value_and_grad(
                         loss_fn, has_aux=True
                     )(p)
-                    # comm hook: same quantize -> clip -> update order as the
-                    # single fused step; the error-feedback residual rides in
-                    # the scan carry
-                    grads, cs = comm_lib.local_quantize(grads, cs, hook)
-                    grads = self._maybe_clip(grads)
-                    new_p, new_os = optimizer.update(grads, os_, p)
-                    return (new_p, new_ms, new_os, cs), loss
 
-                (p, ms, os_, cs), losses = jax.lax.scan(
-                    body, (params, mstate, opt_state, comm_state), stacked
+                    def apply_all():
+                        # comm hook: same quantize -> clip -> update order as
+                        # the single fused step; the error-feedback residual
+                        # rides in the scan carry
+                        g, cs2 = comm_lib.local_quantize(grads, cs, hook)
+                        g = self._maybe_clip(g)
+                        new_p, new_os = optimizer.update(g, os_, p)
+                        return new_p, new_ms, new_os, cs2
+
+                    if not guard_on:
+                        return apply_all() + (sk,), loss
+                    # firewall: per-scanned-step verdict on the f32
+                    # aggregated gradient, pre-quantization; the skip
+                    # counters ride the carry with the residual
+                    ok = guard_lib.tree_all_finite(grads)
+                    new_carry = jax.lax.cond(
+                        ok,
+                        lambda: apply_all() + (guard_lib.reset_consecutive(sk),),
+                        lambda: (p, ms, os_, cs,
+                                 guard_lib.bump_skip_counters(sk)),
+                    )
+                    return new_carry, loss
+
+                (p, ms, os_, cs, sk), losses = jax.lax.scan(
+                    body, (params, mstate, opt_state, comm_state, skipped),
+                    stacked,
                 )
-                return p, ms, os_, cs, losses
+                return p, ms, os_, cs, sk, losses
 
             self._fused_scans[key] = jax.jit(
                 fused_scan, donate_argnums=(0, 1, 2, 3)
@@ -823,6 +880,14 @@ class PreparedOptimizer:
         # comm_hook="bf16_ef": the persistent error-feedback residual (a
         # pytree like the gradients); None for stateless hooks
         self._comm_state = None
+        # numerical guard (resilience/guard.py): the firewall's skip
+        # counters ({"total", "consecutive"} int32 device scalars, the
+        # managed seat of TrainState.skipped_steps); None when guard is off
+        self._skipped = None
+        # model_state as of the START of the current accumulation cycle —
+        # the guard revert target when the whole cycle is skipped (the
+        # cycle is the atomic update unit, native-parity)
+        self._cycle_mstate = None
         # analytic per-update gradient-comm wire bytes (the counter), known
         # once the model's parameters exist
         self.grad_comm_bytes_per_step = None
@@ -858,6 +923,8 @@ class PreparedOptimizer:
             self._comm_state = replicate(
                 acc.mesh, comm_lib.init_residual_tree(model._params)
             )
+        if model._guard_enabled() and self._skipped is None:
+            self._skipped = replicate(acc.mesh, guard_lib.init_skip_counters())
         self.grad_comm_bytes_per_step = comm_lib.comm_bytes_for_hook(
             model._params, acc.mesh.devices.size, hook,
             wus=getattr(acc, "weight_update_sharding", False),
@@ -889,6 +956,7 @@ class PreparedOptimizer:
                     model._params, model._model_state,
                     model._bwd_key, step_idx, xb, yb, wb,
                 )
+                model._mstate_before = model._model_state
                 model._model_state = new_mstate
                 lazy_loss._value = loss
                 self._accumulate(grads, accum)
@@ -937,19 +1005,30 @@ class PreparedOptimizer:
             self._accumulate(grads, accum)
             return
         fn = self._get_apply_update()
+        guard_on = model._guard_enabled()
+        mstates = (
+            (model._mstate_before, model._model_state) if guard_on else None
+        )
         try:
-            model.params, self.opt_state, self._comm_state = fn(
-                grads, self.opt_state, model.params, self._comm_state, 1.0
+            (model.params, self.opt_state, self._comm_state, self._skipped,
+             mstate) = fn(
+                grads, self.opt_state, model.params, self._comm_state,
+                self._skipped, mstates, 1.0,
             )
         except BaseException:
             self._poison_if_donated()
             raise
+        if guard_on:
+            model._model_state = mstate
 
     def _accumulate(self, grads, accum: int):
         """Fold one micro-batch's gradient into the running device-side sum;
         apply ONE averaged (then clipped) update at the cycle boundary."""
         model = self.model
         if self._accum_grads is None:
+            # cycle start: remember the buffers as of BEFORE this cycle's
+            # first forward — the guard reverts a skipped cycle to them
+            self._cycle_mstate = model._mstate_before
             self._accum_grads = grads
         else:
             if self._tree_add is None:
@@ -972,36 +1051,65 @@ class PreparedOptimizer:
             return
         model = self.model
         fn = self._get_apply_update()
+        guard_on = model._guard_enabled()
+        mstates = (
+            (self._cycle_mstate, model._model_state) if guard_on else None
+        )
         try:
-            model._params, self.opt_state, self._comm_state = fn(
+            (model._params, self.opt_state, self._comm_state, self._skipped,
+             mstate) = fn(
                 self._accum_grads, self.opt_state, model._params,
-                self._comm_state, 1.0 / self._accum_count,
+                self._comm_state, self._skipped, mstates,
+                1.0 / self._accum_count,
             )
         except BaseException:
             self._poison_if_donated()
             raise
+        if guard_on:
+            model._model_state = mstate
         self._accum_grads = None
         self._accum_count = 0
+        self._cycle_mstate = None
 
     def _get_apply_update(self):
         """Jitted scale -> comm hook -> clip -> optimizer.update (the hook and
         the clip always apply to the final, averaged gradient — never per
-        micro-batch — matching the native cycle-boundary order)."""
+        micro-batch — matching the native cycle-boundary order). Under the
+        guard, the finiteness verdict on the scaled f32 gradient (checked
+        before quantization) gates the whole tail through ``lax.cond``."""
         if self._update is None:
             clip = getattr(self.model.accelerator, "clip_grad_norm", None)
             hook = self._comm_hook_name()
+            guard_on = self.model._guard_enabled()
 
-            def apply(grads, opt_state, params, comm_state, scale):
+            def apply(grads, opt_state, params, comm_state, skipped, mstates, scale):
                 grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-                grads, comm_state = comm_lib.local_quantize(
-                    grads, comm_state, hook
+
+                def apply_all():
+                    g, cs = comm_lib.local_quantize(grads, comm_state, hook)
+                    if clip is not None:
+                        g, _ = optim_lib.clip_grad_norm_(g, clip)
+                    new_params, new_opt = self.optimizer.update(
+                        g, opt_state, params
+                    )
+                    return new_params, new_opt, cs
+
+                if not guard_on:
+                    new_params, new_opt, cs = apply_all()
+                    return new_params, new_opt, cs, skipped, mstates
+                # mstates = (pre-cycle buffers, post-forward buffers): the
+                # grad-only programs committed model_state eagerly, so the
+                # skip branch must also hand the PRE-cycle buffers back —
+                # a poisoned forward's BN stats die with the skipped update
+                mstate0, mstate_now = mstates
+                ok = guard_lib.tree_all_finite(grads)
+                return jax.lax.cond(
+                    ok,
+                    lambda: apply_all()
+                    + (guard_lib.reset_consecutive(skipped), mstate_now),
+                    lambda: (params, opt_state, comm_state,
+                             guard_lib.bump_skip_counters(skipped), mstate0),
                 )
-                if clip is not None:
-                    grads, _ = optim_lib.clip_grad_norm_(grads, clip)
-                new_params, new_opt = self.optimizer.update(
-                    grads, opt_state, params
-                )
-                return new_params, new_opt, comm_state
 
             self._update = jax.jit(apply, donate_argnums=(0, 1, 2, 3))
         return self._update
@@ -1015,12 +1123,25 @@ class PreparedOptimizer:
         checkpoint error, not JAX's obscure 'Array has been deleted'."""
         model = self.model
         leaves = jax.tree_util.tree_leaves(
-            (model._params, model._model_state, self.opt_state, self._comm_state)
+            (model._params, model._model_state, self.opt_state,
+             self._comm_state, self._skipped)
         )
         if any(getattr(l, "is_deleted", lambda: False)() for l in leaves):
             model._params = model._model_state = _LOST_TO_FAILED_FLUSH
             self.opt_state = None
             self._comm_state = None
+            self._skipped = None
+
+    def skip_counters(self):
+        """Host ``(total, consecutive)`` of the guard's skipped-update
+        counters; ``(0, 0)`` when the guard is off or nothing has stepped.
+        One tiny fetch — call per epoch, not per step."""
+        if self._skipped is None:
+            return 0, 0
+        t, c = jax.device_get(
+            (self._skipped["total"], self._skipped["consecutive"])
+        )
+        return int(t), int(c)
 
     def _run_fused(self, xb, yb, wb, criterion, step_idx, lazy_loss):
         """forward + backward + optimizer update as ONE jit dispatch (the
@@ -1028,9 +1149,10 @@ class PreparedOptimizer:
         model = self.model
         fn = model._get_fused_step(criterion, self.optimizer)
         try:
-            loss, new_params, new_mstate, new_opt, new_comm = fn(
+            loss, new_params, new_mstate, new_opt, new_comm, new_skipped = fn(
                 model._params, model._model_state, self.opt_state,
-                self._comm_state, model._bwd_key, step_idx, xb, yb, wb,
+                self._comm_state, self._skipped, model._bwd_key, step_idx,
+                xb, yb, wb,
             )
         except BaseException:
             self._poison_if_donated()
@@ -1038,6 +1160,7 @@ class PreparedOptimizer:
         model._params, model._model_state = new_params, new_mstate
         self.opt_state = new_opt
         self._comm_state = new_comm
+        self._skipped = new_skipped
         lazy_loss._value = loss
 
     def flush(self):
@@ -1088,13 +1211,14 @@ class PreparedOptimizer:
         xs = tuple(e[0] for e in queue)
         ys = tuple(e[1] for e in queue)
         ws = tuple(e[2] for e in queue)
-        new_params, new_mstate, new_opt, new_comm, losses = fn(
+        new_params, new_mstate, new_opt, new_comm, new_skipped, losses = fn(
             model._params, model._model_state, self.opt_state,
-            self._comm_state, model._bwd_key, idxs, xs, ys, ws,
+            self._comm_state, self._skipped, model._bwd_key, idxs, xs, ys, ws,
         )
         model._params, model._model_state = new_params, new_mstate
         self.opt_state = new_opt
         self._comm_state = new_comm
+        self._skipped = new_skipped
         for i, entry in enumerate(queue):
             lazy_loss = entry[5]
             lazy_loss._value_src = (losses, i)
@@ -1116,6 +1240,7 @@ class Accelerator:
         weight_update_sharding: bool = False,
         comm_hook: str = "none",
         bucket_cap_mb: float = comm_lib.DEFAULT_BUCKET_CAP_MB,
+        guard=None,
     ):
         """``fuse_steps``: K > 1 batches per-step calls into one compiled
         lax.scan dispatch (the managed analog of the native scan fusion) —
@@ -1151,7 +1276,17 @@ class Accelerator:
         the genuine on-the-wire byte reduction is the explicit
         (DistributedDataParallel, shard_map) path's property.
         ``bucket_cap_mb`` is accepted for knob parity (bucketing is a
-        collective-granularity construct of the explicit path)."""
+        collective-granularity construct of the explicit path).
+
+        ``guard``: the numerical guard (resilience/guard.py; same knob as
+        ``DistributedDataParallel``): the fused/scan/accumulation update
+        programs gate the optimizer tail behind a finiteness check on the
+        XLA-aggregated f32 gradient (checked before the comm hook
+        quantizes), a poisoned step is a bitwise no-op counted in the
+        optimizer's skip counters (``PreparedOptimizer.skip_counters()``,
+        round-tripped by save_state/load_state), and ``prepare`` audits
+        every replica's parameter copy. Off by default — identical
+        programs."""
         self.mesh = mesh if mesh is not None else data_mesh(num_chips)
         key, _ = seeding.set_seed_based_on_rank(base_seed=seed)
         self._key = key
@@ -1173,6 +1308,7 @@ class Accelerator:
         self.gradient_accumulation_steps = max(1, int(gradient_accumulation_steps))
         self.weight_update_sharding = bool(weight_update_sharding)
         self.comm_hook = comm_lib.validate_hook(comm_hook)
+        self.guard = guard_lib.resolve_guard(guard)
         self.bucket_cap_mb = float(bucket_cap_mb)
         if self.bucket_cap_mb <= 0:
             # same knob contract as DistributedDataParallel: a config that
@@ -1360,6 +1496,7 @@ class Accelerator:
             opt._queue = []
             opt._accum_grads = None
             opt._accum_count = 0
+            opt._cycle_mstate = None
 
     def _full_state_like(self, model: PreparedModel, optimizer: "PreparedOptimizer"):
         """Template tree for the lossless managed state: weights + buffers +
@@ -1383,6 +1520,10 @@ class Accelerator:
             # after restore. Only present when the hook carries state, so
             # hook-less checkpoints keep their historical structure.
             tree["comm_state"] = optimizer._comm_state
+        if optimizer._skipped is not None:
+            # guard skip counters round-trip like the residual: the rollback
+            # policy's consecutive-run must survive a resume
+            tree["skipped_steps"] = optimizer._skipped
         return tree
 
     def save_state(
@@ -1457,6 +1598,8 @@ class Accelerator:
             optimizer.opt_state = replicate(self.mesh, restored["opt_state"])
         if "comm_state" in restored:
             optimizer._comm_state = replicate(self.mesh, restored["comm_state"])
+        if "skipped_steps" in restored:
+            optimizer._skipped = replicate(self.mesh, restored["skipped_steps"])
         self._key = restored["rng_key"]
         model._bwd_key = restored["bwd_key"]
         model._bwd_counter = int(restored["bwd_counter"])

@@ -72,6 +72,13 @@ TRAINING_DEFAULTS = {
     # lets a scheduler requeue the exact same command after exit 75.
     "keep_last": None,  # checkpoint retention: prune all but the K newest
     # ckpt_{epoch}.npz (+ .sha256 manifests) after each save; None keeps all
+    "guard": None,  # numerical guard block (resilience/guard.py): true, or
+    # {max_consecutive_skips, audit_every_n_epochs, on_desync, max_rollbacks}.
+    # Arms the in-step non-finite-gradient firewall (a poisoned update is a
+    # bitwise no-op counted in TrainState.skipped_steps), the cross-replica
+    # desync auditor (wrap-time + every N epochs; divergence -> exit 77 or
+    # rollback), and the epoch driver's rollback-to-last-good. None/false:
+    # strict no-op — the step lowers to the identical HLO.
     "synthetic_n": None,  # (train, test) sizes for the synthetic dataset /
     # fallback; None -> (2048, 512)
 }

@@ -60,6 +60,10 @@ def pmax(x, axis_name: str = DATA_AXIS):
     return all_reduce(x, "max", axis_name)
 
 
+def pmin(x, axis_name: str = DATA_AXIS):
+    return all_reduce(x, "min", axis_name)
+
+
 def all_gather(x, axis_name: str = DATA_AXIS, axis: int = 0, tiled: bool = False):
     return jax.tree_util.tree_map(
         lambda v: lax.all_gather(v, axis_name, axis=axis, tiled=tiled), x

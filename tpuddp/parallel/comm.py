@@ -57,6 +57,15 @@ vector sharded ``P("data")`` over the mesh — inside ``shard_map`` each
 replica sees its own ``(total,)`` slice, exactly like the weight-update-
 sharded optimizer moments. Checkpointing gathers it cross-host like any
 other sharded leaf (training/checkpoint.py).
+
+Numerical-guard composition (``training.guard``, resilience/guard.py): the
+non-finite firewall checks the f32 gradient payload — post-allreduce on the
+explicit path (bf16 keeps f32's exponent range, so quantization cannot mask
+a non-finite payload from the decompressed check), pre-quantization on the
+auto/managed path where the aggregate already exists — and a skipped step
+hands back the PRE-step residual, so a poisoned ``send`` (gradient +
+residual) never contaminates the error-feedback state (training/step.py's
+``gate``).
 """
 
 from __future__ import annotations

@@ -22,6 +22,7 @@ from tpuddp.nn.loss import CrossEntropyLoss
 from tpuddp.parallel import collectives as col
 from tpuddp.parallel import comm as comm_lib
 from tpuddp.parallel.mesh import data_mesh, replicate, shard_batch
+from tpuddp.resilience import guard as guard_lib
 from tpuddp.training import step as step_lib
 from tpuddp.training.train_state import TrainState, create_train_state
 
@@ -50,6 +51,7 @@ class DistributedDataParallel:
         grad_accumulation: int = 1,
         comm_hook: str = "none",
         bucket_cap_mb: float = comm_lib.DEFAULT_BUCKET_CAP_MB,
+        guard=None,
     ):
         """``weight_update_sharding``: shard the optimizer update + moments
         across the data axis (reduce-scatter grads, update a 1/N parameter
@@ -82,7 +84,16 @@ class DistributedDataParallel:
 
         ``bucket_cap_mb``: bucket size cap for the compressed hooks (torch's
         ``bucket_cap_mb`` knob, default 25): small tensors coalesce into one
-        collective per bucket; boundaries fall on whole-leaf edges."""
+        collective per bucket; boundaries fall on whole-leaf edges.
+
+        ``guard``: the ``training.guard`` block (None/False/True/dict or a
+        :class:`~tpuddp.resilience.guard.GuardConfig`). When enabled, the
+        compiled step gates every optimizer update behind a non-finite
+        gradient firewall (a poisoned step becomes a bitwise no-op counted
+        in ``TrainState.skipped_steps``) and :meth:`init_state` runs the
+        cross-replica desync auditor — the torch
+        ``_verify_params_across_processes`` moment. Off by default; the
+        disabled path lowers to the identical step program."""
         self.model = model
         self.optimizer = optimizer
         self.criterion = criterion if criterion is not None else CrossEntropyLoss()
@@ -114,6 +125,7 @@ class DistributedDataParallel:
         self.bucket_cap_mb = float(bucket_cap_mb)
         if self.bucket_cap_mb <= 0:
             raise ValueError(f"bucket_cap_mb must be > 0, got {bucket_cap_mb!r}")
+        self.guard = guard_lib.resolve_guard(guard)
         self._comm = None
         self._grad_comm_bytes = None
         self._wus_spec = None
@@ -214,9 +226,18 @@ class DistributedDataParallel:
             )
         elif sharded_residual:
             self._state_spec = step_lib.comm_state_spec()
+        if self.guard.enabled:
+            # the firewall's skip counters ride in the state (replicated,
+            # checkpointed); added after every structural rebuild above so no
+            # reconstruction can drop them
+            import dataclasses
+
+            state = dataclasses.replace(
+                state, skipped_steps=guard_lib.init_skip_counters()
+            )
         state = col.broadcast_one_to_all(state)
         if not self.weight_update_sharding and not sharded_residual:
-            return replicate(self.mesh, state)
+            return self._audit_at_wrap(replicate(self.mesh, state))
         # placement follows the state spec's judgment leaf by leaf (ONE
         # predicate for what shards): optimizer vectors / the per-replica
         # comm residual land sharded over the data axis, everything else
@@ -247,7 +268,7 @@ class DistributedDataParallel:
                     self.mesh, step_lib.P(step_lib.DATA_AXIS)
                 ),
             )()
-        return TrainState(
+        return self._audit_at_wrap(TrainState(
             params=replicate(self.mesh, state.params),
             model_state=replicate(self.mesh, state.model_state),
             opt_state=jax.tree_util.tree_map(
@@ -260,7 +281,18 @@ class DistributedDataParallel:
             step=replicate(self.mesh, state.step),
             rng=replicate(self.mesh, state.rng),
             comm_state=comm_state,
-        )
+            skipped_steps=replicate(self.mesh, state.skipped_steps),
+        ))
+
+    def _audit_at_wrap(self, state: TrainState) -> TrainState:
+        """torch DDP's ``_verify_params_across_processes`` moment: under
+        ``guard``, fingerprint every replica's parameter copy before the
+        first step — a construction-time divergence (bad broadcast, corrupt
+        host) surfaces as :class:`~tpuddp.resilience.guard.ReplicaDesync`
+        (exit 77) instead of a silently forked trajectory."""
+        if self.guard.enabled:
+            guard_lib.audit_or_raise(self.mesh, state.params, where="ddp-wrap")
+        return state
 
     def shard(self, batch):
         """Place a host batch onto the mesh, split over the data axis."""
@@ -321,6 +353,7 @@ class DistributedDataParallel:
                 state_spec=self._state_spec,
                 grad_accumulation=self.grad_accumulation,
                 comm=self._comm,
+                guard=self.guard.enabled,
             )
         return self._scan_step(state, stacked_batch)
 
@@ -348,6 +381,7 @@ class DistributedDataParallel:
                 wus_spec=self._wus_spec,
                 state_spec=self._state_spec,
                 comm=self._comm,
+                guard=self.guard.enabled,
             )
         return self._train_step(state, batch)
 

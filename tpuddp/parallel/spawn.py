@@ -29,6 +29,7 @@ from typing import Callable, Optional
 import jax
 
 from tpuddp.parallel import backend as _backend
+from tpuddp.resilience import guard as _guard
 from tpuddp.resilience import preemption as _preemption
 from tpuddp.resilience import watchdog as _watchdog
 
@@ -159,6 +160,12 @@ def run_ddp_training(
     except _preemption.TrainingPreempted as e:
         logger.warning("%s; exiting %d (requeue+resume)", e, _preemption.EXIT_PREEMPTED)
         sys.exit(_preemption.EXIT_PREEMPTED)
+    except _guard.ReplicaDesync as e:
+        # the numerical guard's auditor found a divergent replica: the state
+        # is not trustworthy, so surface the distinct code a scheduler can
+        # requeue into auto-resume (restoring the last intact checkpoint)
+        logger.critical("%s; exiting %d", e, _preemption.EXIT_DESYNC)
+        sys.exit(_preemption.EXIT_DESYNC)
     finally:
         _watchdog.stop(guard)
         _backend.cleanup()

@@ -23,6 +23,11 @@ the way MLPerf-scale DDP work treats it (arxiv 1909.09756, 2509.07003):
                     multi-host path (``$TPUDDP_WATCHDOG_TIMEOUT``), so a dead
                     peer surfaces as a logged exit instead of a silent hang in
                     a collective.
+- ``guard``       — the numerical layer (ISSUE 3): the in-step non-finite
+                    gradient firewall (``training.guard``), the cross-replica
+                    desync auditor (``pmax - pmin`` fingerprints ->
+                    exit 77 / rollback), and the skip counters the epoch
+                    driver's rollback-to-last-good policy watches.
 """
 
 from tpuddp.resilience.preemption import (  # noqa: F401
@@ -30,6 +35,7 @@ from tpuddp.resilience.preemption import (  # noqa: F401
     auto_resume_requested,
     EXIT_PREEMPTED,
     EXIT_WATCHDOG,
+    EXIT_DESYNC,
     TrainingPreempted,
     install_preemption_handler,
     preemption_grace_seconds,
@@ -57,12 +63,21 @@ from tpuddp.resilience.integrity import (  # noqa: F401
     verify_file,
     write_manifest,
 )
+from tpuddp.resilience.guard import (  # noqa: F401
+    DISABLED as GUARD_DISABLED,
+    GuardConfig,
+    ReplicaDesync,
+    audit_or_raise,
+    audit_params,
+    resolve_guard,
+)
 
 __all__ = [
     "EXIT_INJECTED_CRASH",
     "auto_resume_requested",
     "EXIT_PREEMPTED",
     "EXIT_WATCHDOG",
+    "EXIT_DESYNC",
     "TrainingPreempted",
     "install_preemption_handler",
     "preemption_grace_seconds",
@@ -85,4 +100,10 @@ __all__ = [
     "manifest_path",
     "verify_file",
     "write_manifest",
+    "GUARD_DISABLED",
+    "GuardConfig",
+    "ReplicaDesync",
+    "audit_or_raise",
+    "audit_params",
+    "resolve_guard",
 ]

@@ -10,15 +10,21 @@ that crosses the process boundary without patching code.  Grammar::
             preempt  SIGTERM to self — drives the real drain path
             hang     stop heartbeating and sleep forever — the dead peer
             corrupt  garbage the just-written checkpoint file
+            nan      poison one train micro-batch so its loss/gradient go
+                     non-finite — exercises the numerical-guard firewall
+                     (resilience/guard.py) end to end
 
     sites:  epoch=N  checked by the epoch driver at the start of epoch N
             barrier  checked on entry to collectives.barrier
             ckpt_N   checked after checkpoint ``ckpt_N.npz`` is published
+            step=N   checked per train micro-batch (global index from run
+                     start); ``nan`` only — the batch-level injection point
 
 Examples: ``crash@epoch=2``, ``preempt@epoch=1``, ``hang@barrier``,
-``corrupt@ckpt_1``.  Each spec fires at most once per process.  Parsing is
-lazy and cached; :func:`reload_faults` re-reads the env (test isolation).
-Production runs without the env variable pay one cached dict lookup per hook.
+``corrupt@ckpt_1``, ``nan@step=5``.  Each spec fires at most once per
+process.  Parsing is lazy and cached; :func:`reload_faults` re-reads the env
+(test isolation).  Production runs without the env variable pay one cached
+dict lookup per hook.
 """
 
 from __future__ import annotations
@@ -35,7 +41,7 @@ from tpuddp.resilience.preemption import EXIT_INJECTED_CRASH
 logger = logging.getLogger("tpuddp")
 
 _FAULT_ENV = "TPUDDP_FAULT"
-_KINDS = ("crash", "preempt", "hang", "corrupt")
+_KINDS = ("crash", "preempt", "hang", "corrupt", "nan")
 
 _cache = {"raw": None, "specs": None}
 _hung = {"active": False}
@@ -55,6 +61,8 @@ class FaultSpec:
             return str(ctx.get("epoch")) == self.arg
         if self.site == "ckpt":
             return ctx.get("name") == self.arg
+        if self.site == "step":
+            return str(ctx.get("step")) == self.arg
         return True  # barrier (and other argless sites)
 
 
@@ -77,10 +85,20 @@ def parse_fault_specs(raw: str) -> List[FaultSpec]:
             specs.append(FaultSpec(kind, "barrier", None))
         elif point.startswith("ckpt"):
             specs.append(FaultSpec(kind, "ckpt", point))
+        elif point.startswith("step="):
+            specs.append(FaultSpec(kind, "step", point[len("step=") :]))
         else:
             raise ValueError(
                 f"bad {_FAULT_ENV} site {point!r}; expected epoch=N, barrier, "
-                "or ckpt_N"
+                "ckpt_N, or step=N"
+            )
+        # the step site is the batch-poisoning injection point and nan is its
+        # only meaningful kind (process-level kinds have the epoch site);
+        # refuse the cross products so a typo'd spec fails loudly
+        if (specs[-1].kind == "nan") != (specs[-1].site == "step"):
+            raise ValueError(
+                f"bad {_FAULT_ENV} spec {part!r}: kind 'nan' pairs with site "
+                "step=N (and step=N only accepts 'nan')"
             )
     return specs
 
@@ -103,6 +121,42 @@ def is_hung() -> bool:
     stops beating, so the hang is visible to peer watchdogs as a dead process
     would be."""
     return _hung["active"]
+
+
+def has_nan_fault() -> bool:
+    """True while an un-fired ``nan@step=N`` spec is armed — the epoch driver
+    wires the per-batch poison hook only then, so fault-free runs pay
+    nothing per batch."""
+    return any(
+        s.kind == "nan" and not s.fired for s in active_faults()
+    )
+
+
+def maybe_corrupt_batch(batch, step: int):
+    """The ``nan@step=N`` injection point: poison one element of the host
+    micro-batch whose global train-step index matches, so its loss and
+    gradient go non-finite inside the compiled step — the failure the
+    numerical-guard firewall must turn into a bitwise no-op. Floating inputs
+    take the NaN in ``x``; integer/uint8 inputs fall back to a NaN sample
+    weight (same non-finite loss/grad, different carrier). Fires once."""
+    import numpy as np
+
+    for spec in active_faults():
+        if spec.kind == "nan" and spec.matches("step", step=step):
+            spec.fired = True
+            x, y, w = batch
+            x = np.array(x, copy=True)
+            if np.issubdtype(x.dtype, np.floating):
+                x.flat[0] = np.nan
+            else:
+                w = np.array(w, copy=True)
+                w.flat[0] = np.nan
+            logger.critical(
+                "fault injection: nan@step=%d fired (poisoned one train "
+                "micro-batch)", step,
+            )
+            return x, y, w
+    return batch
 
 
 def _corrupt_file(path: str) -> None:

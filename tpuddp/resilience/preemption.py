@@ -35,10 +35,12 @@ from typing import Optional
 logger = logging.getLogger("tpuddp")
 
 # Exit-code contract (README "Fault tolerance"). 75 = EX_TEMPFAIL, the
-# conventional "transient, requeue" code; 76/113 are tpuddp-specific but
+# conventional "transient, requeue" code; 76/77/113 are tpuddp-specific but
 # chosen outside the shell/signal ranges (126-165) and common tool codes.
 EXIT_PREEMPTED = 75  # drained after SIGTERM/SIGINT; safe to requeue + resume
 EXIT_WATCHDOG = 76  # a peer's heartbeat went stale; this process bailed out
+EXIT_DESYNC = 77  # the guard's auditor found a divergent replica; requeue
+# into auto-resume (resilience/guard.py — raised as ReplicaDesync)
 EXIT_INJECTED_CRASH = 113  # $TPUDDP_FAULT crash@... fired (chaos tests only)
 
 _GRACE_ENV = "TPUDDP_PREEMPT_GRACE"

@@ -145,19 +145,27 @@ def load(path: str, like: Any) -> Any:
                     f"{t_raw_shape}"
                 )
             leaves.append(jax.random.wrap_key_data(raw))
-        elif key == ".comm_state" or key.startswith("['comm_state']"):
-            # Anchored to the TrainState field / managed state-dict entry —
+        elif (
+            key == ".comm_state"
+            or key.startswith("['comm_state']")
+            or key.startswith(".skipped_steps")
+            or key.startswith("['skipped_steps']")
+        ):
+            # Anchored to the TrainState fields / managed state-dict entries —
             # a model parameter whose own name merely contains "comm_state"
             # must still hit the missing-leaf error below.
             # Forward-compat: a checkpoint written before the gradient-comm
-            # hook was enabled (comm_hook="none" saves no residual leaf)
-            # loads into a bf16_ef template by keeping the template's
-            # zero-initialized residual — the exact state a fresh compressed
-            # run starts from, so resume is correct, just logged.
+            # hook (comm_hook="none" saves no residual leaf) or before the
+            # numerical guard (guard off saves no skip counters) loads into
+            # the newer template by keeping the template's zero
+            # initialization — the exact state a fresh run of that
+            # configuration starts from, so resume is correct, just logged.
             logger.warning(
-                "checkpoint %s predates comm_hook state: leaf %r starts at "
+                "checkpoint %s predates %s state: leaf %r starts at "
                 "its zero initialization",
-                path, key,
+                path,
+                "guard" if "skipped_steps" in key else "comm_hook",
+                key,
             )
             leaves.append(template)
         else:

@@ -27,6 +27,7 @@ import numpy as np
 from tpuddp import seeding
 from tpuddp.parallel import collectives as col
 from tpuddp.resilience import faults
+from tpuddp.resilience import guard as guard_lib
 from tpuddp.resilience.preemption import (
     TrainingPreempted,
     auto_resume_requested,
@@ -118,7 +119,7 @@ def _never():
 
 def _fused_pass(
     ddp, state, loader, scan_k: int, step_one, step_many, probe_cb=None,
-    accum: int = 1, poll=preemption_requested,
+    accum: int = 1, poll=preemption_requested, inject_cb=None,
 ):
     """One pass over ``loader`` with K-fused dispatch + one-chunk upload
     lookahead (device_put is async, so staging chunk N+1 before dispatching N
@@ -133,11 +134,15 @@ def _fused_pass(
     dispatch, for the emergency checkpoint. Multi-host runs pass ``_never``:
     one host bailing out of the pass mid-epoch while its peers keep issuing
     step collectives would wedge the pod, so the drain decision moves to the
-    epoch boundary where it can be agreed globally."""
+    epoch boundary where it can be agreed globally. ``inject_cb`` (the
+    ``nan@step=N`` chaos hook) may rewrite each host batch before it is
+    staged — wired only while an un-fired nan fault is armed."""
     acc = None
     chunk = []
     staged = None
     for batch_idx, host_batch in enumerate(loader):
+        if inject_cb is not None:
+            host_batch = inject_cb(host_batch)
         if probe_cb is not None:
             probe_cb(batch_idx, host_batch)
         if poll():
@@ -207,6 +212,15 @@ def run_training_loop(
     and raises :class:`TrainingPreempted`, which ``spawn.run_ddp_training``
     turns into exit code 75. ``keep_last=K`` prunes all but the K newest
     checkpoints after each save.
+
+    Numerical guard (``ddp.guard``, resilience/guard.py): the wrap owns the
+    in-step firewall; this driver owns the epoch policy — it reads the skip
+    counters once per epoch into the history record, runs the desync auditor
+    every ``guard.audit_every_n_epochs`` (divergence -> ReplicaDesync/exit 77,
+    or rollback), rolls back to the newest intact checkpoint when more than
+    ``guard.max_consecutive_skips`` updates were skipped back to back, and
+    guards BOTH aggregated losses (``$TPUDDP_DEBUG_NANS``) before any
+    checkpoint so a poisoned epoch can never persist its state.
     """
     is_main = jax.process_index() == 0
     pbytes = _param_bytes(state.params) if hasattr(state, "params") else None
@@ -263,6 +277,57 @@ def run_training_loop(
     )
     profiling = maybe_start_profiler(save_dir)  # $TPUDDP_PROFILE hook
 
+    # ---- numerical guard (resilience/guard.py): the ddp wrap owns the
+    # config; the driver owns the epoch-level policy — skip accounting,
+    # periodic desync audits, rollback-to-last-good.
+    guard_cfg = guard_lib.resolve_guard(getattr(ddp, "guard", None))
+    prev_total_skips = (
+        guard_lib.read_skip_counters(state)[0] if guard_cfg.enabled else 0
+    )
+    rollback_count = {"n": 0}
+
+    def rollback_to_last_good(cur_state, epoch, reason):
+        """Restore the newest integrity-verified checkpoint and hand back
+        ``(state, epoch_to_redo)``. The caller re-enters the epoch loop
+        there, so ``set_epoch`` re-derives the redone epoch's data order.
+        The rollback is a recorded event in history.jsonl, and a bounded one
+        — replaying a persistently-poisoned epoch forever is not recovery."""
+        rollback_count["n"] += 1
+        if rollback_count["n"] > guard_cfg.max_rollbacks:
+            raise RuntimeError(
+                f"guard rollback limit ({guard_cfg.max_rollbacks}) exceeded; "
+                f"last trigger: {reason}. The failure recurs after restoring "
+                "known-good state — a systematic divergence, not a transient."
+            )
+        restored, redo_epoch = ckpt.restore_latest(save_dir, cur_state)
+        metrics_writer.write({
+            "event": "rollback",
+            "epoch": epoch,
+            "resume_epoch": redo_epoch,
+            "reason": reason,
+        })
+        if is_main:
+            log(
+                f"Guard rollback ({reason}): restored last-good checkpoint, "
+                f"redoing from epoch {redo_epoch}."
+            )
+        return restored, redo_epoch
+
+    def can_roll_back() -> bool:
+        return save_dir is not None and ckpt.latest(save_dir) is not None
+
+    # ---- nan@step=N chaos hook (resilience/faults.py): wired only while an
+    # un-fired nan fault is armed, so normal runs pay nothing per batch. The
+    # step index is the global train micro-batch count from loop entry.
+    nan_inject = None
+    if faults.has_nan_fault():
+        _nan_step = {"i": 0}
+
+        def nan_inject(host_batch):
+            out = faults.maybe_corrupt_batch(host_batch, _nan_step["i"])
+            _nan_step["i"] += 1
+            return out
+
     multihost = jax.process_count() > 1
     # single-host: poll the drain flag at every batch-group boundary.
     # multi-host: never inside a pass — one host returning early while peers
@@ -300,10 +365,36 @@ def run_training_loop(
         )
 
     try:
-        for epoch in range(start_epoch, num_epochs):
+        epoch = start_epoch
+        while epoch < num_epochs:
             faults.maybe_fire("epoch", epoch=epoch)  # $TPUDDP_FAULT chaos hook
             if drain_requested():
                 emergency_stop(epoch)
+            if (
+                guard_cfg.enabled
+                and guard_cfg.audit_every_n_epochs
+                and (epoch - start_epoch) % guard_cfg.audit_every_n_epochs == 0
+                and getattr(ddp, "mesh", None) is not None
+            ):
+                # desync audit: ONE fingerprint reduction over the parameter
+                # tree per audited epoch (guard.audit_params cost model) —
+                # the periodic re-run of the wrap-time verify
+                bad_leaf = guard_lib.audit_params(ddp.mesh, state.params)
+                if bad_leaf is not None:
+                    metrics_writer.write(
+                        {"event": "desync", "epoch": epoch, "leaf": bad_leaf}
+                    )
+                    if guard_cfg.on_desync == "rollback" and can_roll_back():
+                        state, epoch = rollback_to_last_good(
+                            state, epoch, f"replica desync at leaf {bad_leaf}"
+                        )
+                        prev_total_skips = guard_lib.read_skip_counters(state)[0]
+                        continue
+                    # no checkpoint to fall back to (or exit policy): the
+                    # distinct code 77 requeues into auto-resume
+                    raise guard_lib.ReplicaDesync(
+                        bad_leaf, where=f"epoch {epoch} audit"
+                    )
             t0 = time.perf_counter()
             if is_main:
                 log(f"Process {jax.process_index()}, Epoch {epoch}")
@@ -329,7 +420,7 @@ def run_training_loop(
             state, train_acc, interrupted = _fused_pass(
                 ddp, state, train_loader, scan_steps,
                 ddp.train_step, ddp.train_step_many, probe_cb=train_probe,
-                accum=accum, poll=poll,
+                accum=accum, poll=poll, inject_cb=nan_inject,
             )
             if interrupted:
                 emergency_stop(epoch)
@@ -372,15 +463,21 @@ def run_training_loop(
                         eval_acc["n"],
                     )
                 )
+                def _count(v):
+                    # a poisoned batch (e.g. an injected NaN sample weight)
+                    # makes the weighted count non-finite; the post-mortem
+                    # log line must print it, not crash on int(NaN)
+                    return int(v) if np.isfinite(v) else float(v)
+
                 for r in range(tl.size):
                     log(
                         f"Train loss on replica {r}: {tl[r] / max(tn[r], 1):.4f} "
-                        f"based on {int(tn[r])} samples"
+                        f"based on {_count(tn[r])} samples"
                     )
                 for r in range(el.size):
                     log(
                         f"Test loss on replica {r}: {el[r] / max(en[r], 1):.4f} "
-                        f"based on {int(en[r])} samples"
+                        f"based on {_count(en[r])} samples"
                     )
 
             # Aggregate the five scalars (reference :198-204) in ONE fused
@@ -415,9 +512,25 @@ def run_training_loop(
                 "samples_per_sec": (train_m["n"] + eval_m["n"]) / max(epoch_time, 1e-9),
             }
             record.update(comm_counter.snapshot(epoch_updates))
+
+            # ---- guard skip accounting: ONE tiny counter fetch per epoch.
+            epoch_skips = consec_skips = 0
+            if guard_cfg.enabled:
+                total_skips, consec_skips = guard_lib.read_skip_counters(state)
+                epoch_skips = total_skips - prev_total_skips
+                prev_total_skips = total_skips
+                record["skipped_steps"] = total_skips
+                record["skipped_steps_epoch"] = epoch_skips
+
             history.append(record)
-            metrics_writer.write(record)
-            check_finite(train_loss, "train loss")  # $TPUDDP_DEBUG_NANS guard
+            metrics_writer.write(record)  # post-mortem row always lands
+            # $TPUDDP_DEBUG_NANS: BOTH aggregated losses are guarded BEFORE
+            # any checkpoint below — a poisoned epoch must never persist its
+            # state (the pre-fix ordering only checked the train loss, so a
+            # finite-train/NaN-test epoch could still be checkpointed).
+            check_finite(train_loss, "train loss")
+            if eval_m["n"]:  # the empty-test-loader NaN placeholder is benign
+                check_finite(test_loss, "test loss")
 
             if profiling and epoch == start_epoch:
                 stop_profiler()  # trace the first epoch only
@@ -432,10 +545,38 @@ def run_training_loop(
                     f"Test Accuracy: {test_accuracy:.2f}%"
                 )
 
+            if consec_skips > guard_cfg.max_consecutive_skips:
+                # the firewall is skipping updates back to back: training is
+                # not progressing, and the last pre-skip metrics/EF residual
+                # may already be suspect — restore last-good instead of
+                # checkpointing a wedged trajectory
+                if can_roll_back():
+                    state, epoch = rollback_to_last_good(
+                        state, epoch,
+                        f"{consec_skips} consecutive non-finite updates skipped",
+                    )
+                    prev_total_skips = guard_lib.read_skip_counters(state)[0]
+                    continue
+                raise FloatingPointError(
+                    f"non-finite gradients forced {consec_skips} consecutive "
+                    "skipped updates and no checkpoint exists to roll back to "
+                    "(set save_dir / checkpoint_epoch to arm rollback)"
+                )
+
             if save_dir is not None and epoch % checkpoint_epoch == 0:
+                if epoch_skips:
+                    # a guarded state is safe to checkpoint (skipped updates
+                    # are bitwise no-ops), but never silently: the save and
+                    # the skips it survived are one logged fact
+                    logger.warning(
+                        "checkpointing epoch %d after %d skipped update(s) "
+                        "this epoch (total %d)",
+                        epoch, epoch_skips, record["skipped_steps"],
+                    )
                 ckpt.save_on_main(
                     save_dir, epoch, state, keep_last=keep_last
                 )
+            epoch += 1
     finally:
         # An exception mid-epoch (preemption, NaN guard, a worker crash) must
         # not lose the trace — it is the post-mortem artifact — nor leave the

@@ -40,6 +40,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from tpuddp import optim as _optim
 from tpuddp.nn.core import Context
 from tpuddp.parallel import collectives as col
+from tpuddp.resilience import guard as guard_lib
 from tpuddp.utils.compat import shard_map
 from tpuddp.parallel.mesh import DATA_AXIS, data_sharded, replicated
 from tpuddp.seeding import fold_in_axis_index
@@ -113,6 +114,8 @@ def sharded_state_spec(opt_state_template, spec: FlatParamSpec, comm=None):
         comm_state=(
             P(DATA_AXIS) if comm is not None and comm.needs_residual else P()
         ),
+        skipped_steps=P(),  # guard counters replicate (P() is a safe prefix
+        # for the empty subtree when the guard is off)
     )
 
 
@@ -122,7 +125,7 @@ def comm_state_spec():
     weight-update sharding): everything replicated except ``comm_state``."""
     return TrainState(
         params=P(), model_state=P(), opt_state=P(), step=P(), rng=P(),
-        comm_state=P(DATA_AXIS),
+        comm_state=P(DATA_AXIS), skipped_steps=P(),
     )
 
 
@@ -228,17 +231,51 @@ def _make_update_fn(
     clip_grad_norm: Optional[float],
     wus_spec: Optional[FlatParamSpec],
     comm=None,
+    guard: bool = False,
 ):
     """The optimizer half of the train step: replica-local mean gradients in,
-    ``(new_params, new_opt_state, new_comm_state)`` out. Owns the
-    cross-replica exchange (pmean, a compressed bucketed psum when a comm
-    hook is configured, or reduce-scatter/all-gather under weight-update
-    sharding) and the clip-after-aggregate. ``comm`` is a
+    ``(new_params, new_opt_state, new_comm_state, new_skipped)`` out. Owns
+    the cross-replica exchange (pmean, a compressed bucketed psum when a
+    comm hook is configured, or reduce-scatter/all-gather under
+    weight-update sharding) and the clip-after-aggregate. ``comm`` is a
     :class:`tpuddp.parallel.comm.GradComm` plan (None or hook "none" keeps
     the legacy full-precision path byte-identical); ``comm_state`` threads
-    the bf16_ef error-feedback residual through the step."""
+    the bf16_ef error-feedback residual through the step.
 
-    def apply_update(params, opt_state, grads, comm_state):
+    ``guard=True`` arms the non-finite gradient firewall
+    (resilience/guard.py): ONE fused finiteness reduction over the
+    aggregated f32 gradient — post-allreduce, so a NaN/Inf on any replica
+    propagates through the sum and every replica agrees on the verdict by
+    construction; with a comm hook the check runs on the decompressed f32
+    payload (auto mode checks before quantization, where the aggregate
+    already exists) — gates clip + optimizer.update through ``lax.cond``. A
+    bad step is a bitwise no-op on params/opt-state/EF-residual and bumps
+    the ``skipped_steps`` counters. ``guard=False`` is the pre-guard code
+    path verbatim (identical HLO, ``skipped`` passes through untouched)."""
+
+    def gate(ok, do_update, params, opt_state, comm_state, skipped):
+        """The lax.cond firewall gate: ``do_update() -> (params, opt, comm)``
+        executes only on a finite aggregated gradient; the skip branch hands
+        the inputs back bitwise (the EF residual included — its NaN-poisoned
+        candidate is never materialized into the carry) and bumps the
+        counters. ``consecutive`` resets on every applied update."""
+
+        def _apply():
+            new_params, new_opt_state, new_comm = do_update()
+            return (
+                new_params, new_opt_state, new_comm,
+                guard_lib.reset_consecutive(skipped),
+            )
+
+        def _skip():
+            return (
+                params, opt_state, comm_state,
+                guard_lib.bump_skip_counters(skipped),
+            )
+
+        return jax.lax.cond(ok, _apply, _skip)
+
+    def apply_update(params, opt_state, grads, comm_state, skipped):
         if wus_spec is not None:
             # Weight-update sharding (the cross-replica weight-update recipe
             # of arxiv.org/abs/2004.13336, ZeRO-1's TPU-native shape): instead
@@ -259,7 +296,7 @@ def _make_update_fn(
                 # comm-hook composition: scatter the COMPRESSED payload —
                 # half the gradient wire bytes; the bf16_ef residual stays
                 # full-length and replica-local (see comm.reduce_scatter)
-                g_shard, comm_state = comm.reduce_scatter(
+                g_shard, new_comm = comm.reduce_scatter(
                     g_vec, comm_state, axis_name
                 )
             else:
@@ -269,46 +306,87 @@ def _make_update_fn(
                     )
                     / world
                 )
-            if clip_grad_norm is not None:
-                # the global norm of a sharded vector is one scalar psum away;
-                # padding zeros contribute nothing
-                norm = jnp.sqrt(
-                    jax.lax.psum(jnp.sum(jnp.square(g_shard)), axis_name)
-                )
-                g_shard = g_shard * jnp.minimum(
-                    1.0, clip_grad_norm / (norm + 1e-6)
-                )
-            idx = jax.lax.axis_index(axis_name)
-            p_vec = _tree_to_vec(params, wus_spec)
-            p_shard = jax.lax.dynamic_slice(
-                p_vec, (idx * shard_n,), (shard_n,)
-            )
-            new_p_shard, new_opt_state = optimizer.update(
-                g_shard, opt_state, p_shard
-            )
-            new_p_vec = jax.lax.all_gather(
-                new_p_shard, axis_name, tiled=True
-            )
-            return _vec_to_tree(new_p_vec, wus_spec), new_opt_state, comm_state
+                new_comm = comm_state
 
+            def wus_update(g_shard=g_shard, new_comm=new_comm):
+                g = g_shard
+                if clip_grad_norm is not None:
+                    # the global norm of a sharded vector is one scalar psum
+                    # away; padding zeros contribute nothing
+                    norm = jnp.sqrt(
+                        jax.lax.psum(jnp.sum(jnp.square(g)), axis_name)
+                    )
+                    g = g * jnp.minimum(1.0, clip_grad_norm / (norm + 1e-6))
+                idx = jax.lax.axis_index(axis_name)
+                p_vec = _tree_to_vec(params, wus_spec)
+                p_shard = jax.lax.dynamic_slice(
+                    p_vec, (idx * shard_n,), (shard_n,)
+                )
+                new_p_shard, new_opt_state = optimizer.update(
+                    g, opt_state, p_shard
+                )
+                new_p_vec = jax.lax.all_gather(
+                    new_p_shard, axis_name, tiled=True
+                )
+                return _vec_to_tree(new_p_vec, wus_spec), new_opt_state, new_comm
+
+            if not guard:
+                new_params, new_opt_state, new_comm = wus_update()
+                return new_params, new_opt_state, new_comm, skipped
+            # the scattered shards of the aggregated gradient live on
+            # different replicas, so the local shard verdict must be agreed
+            # globally: one scalar pmin next to the scatter. Every other
+            # collective (clip psum, all-gather) sits inside the cond — all
+            # replicas take the same branch, so they still pair up.
+            ok = (
+                col.pmin(
+                    guard_lib.tree_all_finite(g_shard).astype(jnp.int32),
+                    axis_name,
+                )
+                == 1
+            )
+            return gate(ok, wus_update, params, opt_state, comm_state, skipped)
+
+        ok = None
+        if guard and axis_name is None:
+            # auto/managed mode: XLA's partitioner already aggregated inside
+            # backward — `grads` IS the global-batch f32 gradient, checked
+            # here BEFORE the hook quantizes it (the f32-payload contract)
+            ok = guard_lib.tree_all_finite(grads)
         if comm is not None and comm.compressed:
             # bucketed compressed allreduce (torch DDP comm-hook analog):
             # flatten -> per-bucket bf16 psum -> f32 decompress -> mean.
             # With axis_name=None (auto mode) this is the local quantization
             # emulation — XLA's implicit psum already aggregated.
-            grads, comm_state = comm.reduce(grads, comm_state, axis_name)
+            agg_grads, new_comm = comm.reduce(grads, comm_state, axis_name)
         elif axis_name is not None:
             # THE DDP step: average gradients across replicas (reference
             # :125's implicit NCCL allreduce). In auto mode XLA inserts
             # this itself.
-            grads = col.pmean(grads, axis_name)
-        if clip_grad_norm is not None:
-            # clip-before-aggregate caveat (reference README): clip the
-            # *averaged* grad, identically on all replicas.
-            grads, _ = _optim.clip_grad_norm_(grads, clip_grad_norm)
+            agg_grads, new_comm = col.pmean(grads, axis_name), comm_state
+        else:
+            agg_grads, new_comm = grads, comm_state
+        if guard and ok is None:
+            # post-allreduce f32 gradient: the sum propagated any replica's
+            # NaN/Inf everywhere, so this replica-local check IS the global
+            # verdict — no extra collective on the replicated path. (bf16
+            # keeps the f32 exponent range, so quantization cannot mask a
+            # non-finite f32 payload from the post-reduce check.)
+            ok = guard_lib.tree_all_finite(agg_grads)
 
-        new_params, new_opt_state = optimizer.update(grads, opt_state, params)
-        return new_params, new_opt_state, comm_state
+        def plain_update(agg_grads=agg_grads, new_comm=new_comm):
+            g = agg_grads
+            if clip_grad_norm is not None:
+                # clip-before-aggregate caveat (reference README): clip the
+                # *averaged* grad, identically on all replicas.
+                g, _ = _optim.clip_grad_norm_(g, clip_grad_norm)
+            new_params, new_opt_state = optimizer.update(g, opt_state, params)
+            return new_params, new_opt_state, new_comm
+
+        if not guard:
+            new_params, new_opt_state, new_comm = plain_update()
+            return new_params, new_opt_state, new_comm, skipped
+        return gate(ok, plain_update, params, opt_state, comm_state, skipped)
 
     return apply_update
 
@@ -324,6 +402,7 @@ def _make_train_core(
     remat: bool = False,
     wus_spec: Optional[FlatParamSpec] = None,
     comm=None,
+    guard: bool = False,
 ):
     _validate_sync_buffers(model, axis_name, sync_buffers)
     if wus_spec is not None and axis_name is None:
@@ -336,14 +415,25 @@ def _make_train_core(
         model, criterion, axis_name, sync_buffers, augment, remat
     )
     apply_update = _make_update_fn(
-        optimizer, axis_name, clip_grad_norm, wus_spec, comm=comm
+        optimizer, axis_name, clip_grad_norm, wus_spec, comm=comm, guard=guard
     )
 
     def core(state: TrainState, x, y, w):
         grads, model_state, loss, n = grad_core(state, x, y, w)
-        new_params, new_opt_state, new_comm = apply_update(
-            state.params, state.opt_state, grads, state.comm_state
+        new_params, new_opt_state, new_comm, new_skipped = apply_update(
+            state.params, state.opt_state, grads, state.comm_state,
+            state.skipped_steps,
         )
+        if guard:
+            # extend the no-op to the module buffers: BatchNorm running
+            # stats computed from the poisoned forward must not outlive the
+            # skipped update (the counters move only on a skip, so the
+            # select is exactly the firewall's verdict)
+            skipped_now = new_skipped["total"] != state.skipped_steps["total"]
+            model_state = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(skipped_now, old, new),
+                state.model_state, model_state,
+            )
         metrics = {
             "loss_sum": (loss * n)[None],  # sample-weighted, reference :131
             "n": n[None],
@@ -355,6 +445,7 @@ def _make_train_core(
             step=state.step + 1,
             rng=state.rng,
             comm_state=new_comm,
+            skipped_steps=new_skipped,
         )
         return new_state, metrics
 
@@ -393,6 +484,7 @@ def build_train_step(
     wus_spec: Optional[FlatParamSpec] = None,
     state_spec=None,
     comm=None,
+    guard: bool = False,
 ):
     """Compile the DP train step over ``mesh``. Returns
     ``step(state, (x, y, w)) -> (new_state, metrics)`` with donated state.
@@ -401,12 +493,16 @@ def build_train_step(
     (a :class:`tpuddp.parallel.comm.GradComm`) switches the gradient
     exchange to the bucketed compressed hook pipeline; a bf16_ef hook needs
     a ``state_spec`` marking ``comm_state`` sharded (:func:`comm_state_spec`
-    or :func:`sharded_state_spec` with ``comm=``)."""
+    or :func:`sharded_state_spec` with ``comm=``). ``guard=True`` arms the
+    non-finite gradient firewall (state must carry ``skipped_steps``
+    counters; see resilience/guard.py); ``False`` lowers to the identical
+    program as before the guard existed."""
     if mode == "shard_map":
         st_spec = state_spec if state_spec is not None else P()
         core = _make_train_core(
             model, criterion, optimizer, DATA_AXIS, sync_buffers,
             clip_grad_norm, augment, remat, wus_spec=wus_spec, comm=comm,
+            guard=guard,
         )
         fn = shard_map(
             core,
@@ -420,6 +516,7 @@ def build_train_step(
         core = _make_train_core(
             model, criterion, optimizer, None, sync_buffers,
             clip_grad_norm, augment, remat, wus_spec=wus_spec, comm=comm,
+            guard=guard,
         )
         jitted = jax.jit(
             core,
@@ -451,6 +548,7 @@ def build_train_scan_step(
     state_spec=None,
     grad_accumulation: int = 1,
     comm=None,
+    guard: bool = False,
 ):
     """Multi-step variant: runs K train steps per jit call via ``lax.scan``.
 
@@ -496,6 +594,7 @@ def build_train_scan_step(
         core = _make_train_core(
             model, criterion, optimizer, axis_name, sync_buffers,
             clip_grad_norm, augment, remat, wus_spec=wus_spec, comm=comm,
+            guard=guard,
         )
 
         def multi(state: TrainState, xs, ys, ws):
@@ -512,7 +611,8 @@ def build_train_scan_step(
             model, criterion, axis_name, sync_buffers, augment, remat
         )
         apply_update = _make_update_fn(
-            optimizer, axis_name, clip_grad_norm, wus_spec, comm=comm
+            optimizer, axis_name, clip_grad_norm, wus_spec, comm=comm,
+            guard=guard,
         )
 
         def multi(state: TrainState, xs, ys, ws):
@@ -532,6 +632,7 @@ def build_train_scan_step(
 
             def cycle(st, cyc_batch):
                 zeros = jax.tree_util.tree_map(jnp.zeros_like, st.params)
+                ms0 = st.model_state  # pre-cycle buffers for the guard revert
 
                 def micro(carry, mb):
                     st, gacc, nacc = carry
@@ -551,6 +652,7 @@ def build_train_scan_step(
                         step=st.step + 1,
                         rng=st.rng,
                         comm_state=st.comm_state,
+                        skipped_steps=st.skipped_steps,
                     )
                     m = {"loss_sum": (loss * n)[None], "n": n[None]}
                     return (st, gacc, nacc + n), m
@@ -562,16 +664,30 @@ def build_train_scan_step(
                 # (guard only the all-padding nacc==0 case, like nn/loss.py)
                 denom = jnp.where(nacc == 0, 1.0, nacc)
                 g = jax.tree_util.tree_map(lambda a: a / denom, gacc)
-                new_params, new_opt_state, new_comm = apply_update(
-                    st.params, st.opt_state, g, st.comm_state
+                # the firewall (guard=True) checks THIS aggregated
+                # cycle-mean gradient: one poisoned micro-batch skips the
+                # whole cycle's update, bitwise
+                new_params, new_opt_state, new_comm, new_skipped = apply_update(
+                    st.params, st.opt_state, g, st.comm_state, st.skipped_steps
                 )
+                model_state = st.model_state
+                if guard:
+                    # a skipped cycle also reverts the buffers the cycle's
+                    # forwards (poisoned micro-batch included) accumulated —
+                    # the cycle is the atomic update unit
+                    skipped_now = new_skipped["total"] != st.skipped_steps["total"]
+                    model_state = jax.tree_util.tree_map(
+                        lambda old, new: jnp.where(skipped_now, old, new),
+                        ms0, st.model_state,
+                    )
                 st = TrainState(
                     params=new_params,
-                    model_state=st.model_state,
+                    model_state=model_state,
                     opt_state=new_opt_state,
                     step=st.step,
                     rng=st.rng,
                     comm_state=new_comm,
+                    skipped_steps=new_skipped,
                 )
                 metrics = jax.tree_util.tree_map(
                     lambda a: jnp.sum(a, axis=0), stacked

@@ -30,11 +30,22 @@ class TrainState:
     # configured hook carries no state, so every pre-existing TrainState
     # construction and checkpoint stays byte-identical.
     comm_state: Any = None
+    # Numerical-guard skip counters (resilience/guard.py): under
+    # training.guard the non-finite-gradient firewall increments
+    # {"total", "consecutive"} int32 scalars whenever it turns a poisoned
+    # optimizer update into a bitwise no-op; the epoch driver reads them to
+    # log skips and trigger rollback-to-last-good. None (no leaf, no
+    # checkpoint entry) when the guard is off — same compatibility contract
+    # as comm_state.
+    skipped_steps: Any = None
 
 
 jax.tree_util.register_dataclass(
     TrainState,
-    data_fields=["params", "model_state", "opt_state", "step", "rng", "comm_state"],
+    data_fields=[
+        "params", "model_state", "opt_state", "step", "rng", "comm_state",
+        "skipped_steps",
+    ],
     meta_fields=[],
 )
 

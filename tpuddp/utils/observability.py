@@ -9,7 +9,10 @@
 - **NaN detection**: ``TPUDDP_DEBUG_NANS=1`` makes the epoch driver raise on
   non-finite aggregated losses (the "race detection / sanitizer" row of
   SURVEY.md §5 — JAX's functional purity removes data races; numerical blowup
-  is the failure mode worth a guard).
+  is the failure mode worth a guard). The epoch driver fires it BEFORE any
+  checkpoint save, so a poisoned epoch can never persist its state. The
+  in-step complement — skipping the poisoned update itself — is the
+  ``training.guard`` firewall (tpuddp/resilience/guard.py).
 - **Metrics**: per-epoch JSONL history written by process 0 next to the
   checkpoints, replacing grep-able stdout as the machine-readable record
   (condor .out parsing in the reference, submit_job.py:36-38).
@@ -59,6 +62,26 @@ def stop_profiler() -> None:
 
 def nan_checks_enabled() -> bool:
     return os.environ.get(_NANS_ENV, "") not in ("", "0")
+
+
+def json_sanitize(value):
+    """Strict-JSON form of a record: non-finite floats become ``None``
+    (serialized ``null``), recursively through dicts/lists/tuples.
+
+    Python's ``json.dumps`` default emits bare ``NaN``/``Infinity`` tokens —
+    *invalid* JSON that strict parsers (jq, serde, JSON.parse, BigQuery
+    loads) reject, which made ``history.jsonl`` and ``bench_results.json``
+    unconsumable the moment an epoch blew up (the empty-test-loader path
+    writes ``float("nan")`` test metrics by design). Writers here pair this
+    with ``json.dumps(..., allow_nan=False)`` so any future non-finite leak
+    fails loudly at write time instead of corrupting the artifact."""
+    if isinstance(value, dict):
+        return {k: json_sanitize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [json_sanitize(v) for v in value]
+    if isinstance(value, float) and not math.isfinite(value):
+        return None
+    return value
 
 
 def check_finite(value: float, what: str) -> None:
@@ -127,7 +150,10 @@ class MetricsWriter:
             return
         if self._f is None:
             self._f = open(self.path, "a")
-        self._f.write(json.dumps(record) + "\n")
+        # strict JSON on disk: NaN/Inf metrics (a blown-up epoch's
+        # post-mortem row) serialize as null, never as the bare NaN token
+        # strict parsers reject
+        self._f.write(json.dumps(json_sanitize(record), allow_nan=False) + "\n")
         self._f.flush()
 
     def flush(self) -> None:

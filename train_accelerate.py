@@ -25,7 +25,9 @@ import numpy as np
 from tpuddp import config as cfg_lib
 from tpuddp import nn, optim
 from tpuddp.accelerate import Accelerator
+from tpuddp.resilience.guard import ReplicaDesync
 from tpuddp.resilience.preemption import (
+    EXIT_DESYNC,
     EXIT_PREEMPTED,
     TrainingPreempted,
     auto_resume_requested,
@@ -172,6 +174,7 @@ def run_training_loop(
     # $TPUDDP_PROFILE traces the first epoch, $TPUDDP_DEBUG_NANS guards the
     # aggregated losses, and process 0 appends history.jsonl next to the
     # checkpoints.
+    from tpuddp.resilience import guard as guard_lib
     from tpuddp.utils.observability import (
         MetricsWriter,
         check_finite,
@@ -181,6 +184,40 @@ def run_training_loop(
 
     metrics_writer = MetricsWriter(save_dir)
     profiling = maybe_start_profiler(save_dir)
+    guard_cfg = guard_lib.resolve_guard(getattr(accelerator, "guard", None))
+    prev_skips = optimizer.skip_counters()[0] if guard_cfg.enabled else 0
+    rollback_count = {"n": 0}
+
+    def rollback_to_last_good(epoch, reason):
+        """Managed rollback-to-last-good (native-driver parity): restore the
+        newest intact ``state_{epoch}.npz`` via load_state — weights,
+        moments, EF residual, skip counters, RNG stream — record the event,
+        and hand back the epoch to redo (``set_epoch`` re-derives its data
+        order). Returns None when no state file exists (caller escalates)."""
+        from tpuddp.training import checkpoint as _ckpt
+
+        if _ckpt.latest(save_dir, prefix="state") is None:
+            return None
+        rollback_count["n"] += 1
+        if rollback_count["n"] > guard_cfg.max_rollbacks:
+            raise RuntimeError(
+                f"guard rollback limit ({guard_cfg.max_rollbacks}) exceeded; "
+                f"last trigger: {reason}. The failure recurs after restoring "
+                "known-good state — a systematic divergence, not a transient."
+            )
+        redo_epoch = accelerator.load_state(model, optimizer, save_dir)
+        metrics_writer.write({
+            "event": "rollback",
+            "epoch": epoch,
+            "resume_epoch": redo_epoch,
+            "reason": reason,
+        })
+        if accelerator.is_local_main_process:
+            print(
+                f"Guard rollback ({reason}): restored last-good state, "
+                f"redoing from epoch {redo_epoch}."
+            )
+        return redo_epoch
     def drain(last_completed_epoch):
         """Preemption drain (SIGTERM/SIGINT seen at a managed-loop boundary):
         publish the lossless state of the last fully-trained epoch so a
@@ -199,9 +236,36 @@ def run_training_loop(
         raise TrainingPreempted(last_completed_epoch + 1)
 
     try:
-        for epoch in range(start_epoch, num_epochs):
+        epoch = start_epoch
+        while epoch < num_epochs:
             if preemption_requested():
                 drain(epoch - 1)
+            if (
+                guard_cfg.enabled
+                and guard_cfg.audit_every_n_epochs
+                and (epoch - start_epoch) % guard_cfg.audit_every_n_epochs == 0
+                and model._params is not None
+            ):
+                # periodic cross-replica desync audit (one fingerprint
+                # reduction; resilience/guard.py): divergence rolls back to
+                # the newest state_{epoch}.npz when configured, else (or
+                # with nothing to restore) exits 77 into auto-resume
+                bad_leaf = guard_lib.audit_params(accelerator.mesh, model._params)
+                if bad_leaf is not None:
+                    metrics_writer.write(
+                        {"event": "desync", "epoch": epoch, "leaf": bad_leaf}
+                    )
+                    if guard_cfg.on_desync == "rollback":
+                        redo = rollback_to_last_good(
+                            epoch, f"replica desync at leaf {bad_leaf}"
+                        )
+                        if redo is not None:
+                            epoch = redo
+                            prev_skips = optimizer.skip_counters()[0]
+                            continue
+                    raise guard_lib.ReplicaDesync(
+                        bad_leaf, where=f"epoch {epoch} audit"
+                    )
             train_loader.set_epoch(epoch)
             epoch_t0 = time.perf_counter()
             train_loss, train_samples = train(
@@ -239,9 +303,28 @@ def run_training_loop(
                     f"Test Loss: {test_loss:.4f}, "
                     f"Test Accuracy: {test_accuracy:.2f}%"
                 )
+            # guard skip accounting: one tiny counter fetch per epoch, and
+            # a skip is never silent next to a checkpoint
+            guard_fields = {}
+            consec_skips = 0
+            if guard_cfg.enabled:
+                total_skips, consec_skips = optimizer.skip_counters()
+                guard_fields = {
+                    "skipped_steps": total_skips,
+                    "skipped_steps_epoch": total_skips - prev_skips,
+                }
+                prev_skips = total_skips
+                if guard_fields["skipped_steps_epoch"] and accelerator.is_local_main_process:
+                    print(
+                        f"Guard: skipped {guard_fields['skipped_steps_epoch']} "
+                        f"non-finite update(s) in epoch {epoch} "
+                        f"(total {total_skips})."
+                    )
+
             # native-driver record schema (training/loop.py), written BEFORE
             # the NaN guard so a blown-up epoch still leaves its post-mortem
-            # row in history.jsonl
+            # row in history.jsonl (non-finite values land as strict-JSON
+            # null, never a bare NaN token)
             metrics_writer.write(
                 {
                     "epoch": epoch,
@@ -253,10 +336,33 @@ def run_training_loop(
                     "epoch_time_s": epoch_time,
                     "samples_per_sec": (train_samples + test_samples)
                     / max(epoch_time, 1e-9),
+                    **guard_fields,
                 }
             )
-            check_finite(train_loss, "train loss")  # $TPUDDP_DEBUG_NANS guard
-            check_finite(test_loss, "test loss")
+            # $TPUDDP_DEBUG_NANS: both losses guarded BEFORE the checkpoint
+            # below — a poisoned epoch must never persist its state
+            check_finite(train_loss, "train loss")
+            if test_samples:
+                check_finite(test_loss, "test loss")
+
+            if consec_skips > guard_cfg.max_consecutive_skips:
+                # the firewall is skipping updates back to back — training
+                # stalled on frozen weights. Roll back to the last saved
+                # state, or fail loudly; never finish 0 having silently
+                # trained nothing (native-driver parity, training/loop.py).
+                redo = rollback_to_last_good(
+                    epoch,
+                    f"{consec_skips} consecutive non-finite updates skipped",
+                )
+                if redo is not None:
+                    epoch = redo
+                    prev_skips = optimizer.skip_counters()[0]
+                    continue
+                raise FloatingPointError(
+                    f"non-finite gradients forced {consec_skips} consecutive "
+                    "skipped updates and no saved state exists to roll back "
+                    "to (lower checkpoint_epoch to arm rollback)"
+                )
 
             if epoch % checkpoint_epoch == 0:
                 # barrier, then a single-writer save of the unwrapped weights
@@ -266,6 +372,7 @@ def run_training_loop(
                 accelerator.wait_for_everyone()
                 accelerator.save_model(model, save_dir)
                 accelerator.save_state(model, optimizer, save_dir, epoch=epoch)
+            epoch += 1
     finally:
         if profiling:
             # an exception mid-first-epoch must still flush the trace (it is
@@ -304,6 +411,9 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         # training.comm_hook knob as the native entrypoint
         comm_hook=str(training.get("comm_hook") or "none"),
         bucket_cap_mb=float(training.get("bucket_cap_mb") or 25),
+        # numerical guard (resilience/guard.py): non-finite-update firewall
+        # in the fused/scan/accumulation programs + prepare-time desync audit
+        guard=training.get("guard"),
     )
 
     # Data + model (reference :118-122); placement is implicit on this path.
@@ -445,3 +555,8 @@ if __name__ == "__main__":
             "%s; exiting %d (requeue+resume)", e, EXIT_PREEMPTED
         )
         raise SystemExit(EXIT_PREEMPTED)
+    except ReplicaDesync as e:
+        # 77: a replica's parameters diverged (guard auditor) — the state is
+        # untrustworthy; requeue into auto-resume from the last intact state
+        logging.getLogger("tpuddp").critical("%s; exiting %d", e, EXIT_DESYNC)
+        raise SystemExit(EXIT_DESYNC)

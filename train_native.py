@@ -133,6 +133,10 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         # bf16/bf16_ef halve the gradient interconnect bytes per step
         comm_hook=str(training.get("comm_hook") or "none"),
         bucket_cap_mb=float(training.get("bucket_cap_mb") or 25),
+        # numerical guard (resilience/guard.py): non-finite-update firewall +
+        # desync auditor + rollback-to-last-good; off (exact legacy step)
+        # unless the training.guard block asks for it
+        guard=training.get("guard"),
     )
     in_hw = size if size else train_ds.images.shape[1]
     state = ddp.init_state(
