@@ -55,16 +55,11 @@ import time
 
 import numpy as np
 
-# Peak bf16 MXU FLOP/s per chip by device kind (public spec sheets). MFU is
+# Peak bf16 MXU FLOP/s per chip by device kind — ONE table for the bench and
+# the training-loop telemetry (tpuddp/observability/recorder.py). MFU is
 # always reported against the bf16 peak: on TPU, f32 matmuls execute on the
 # MXU with bf16 multiplies by default, so bf16 peak is the one ceiling.
-PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v4": 275e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,  # v6e / Trillium
-}
+from tpuddp.observability import PEAK_FLOPS  # noqa: E402
 
 RESULTS = {}  # name -> {samples_per_sec_per_chip, ms_per_step, mfu}
 
@@ -114,10 +109,17 @@ def _record(name, sps_per_chip, ms_per_step, flops_per_chip_step, extra=None):
     log(f"{name}: {sps_per_chip:,.0f} samples/s/chip, {ms_per_step:.2f} ms/step{mfu_s}")
 
 
-def _make_runner(ddp, state_box, batch, scan):
+def _make_runner(ddp, state_box, batch, scan, laps=None):
     """Build run(n_steps) over pre-staged device buffers. Warmup calls must
     reuse the SAME buffers that are timed later: device_put is lazy on
-    remote-tunneled runtimes, so a buffer's first use pays its upload."""
+    remote-tunneled runtimes, so a buffer's first use pays its upload.
+
+    ``laps`` (a list) collects one wall-clock lap per dispatch — the raw
+    material for the per-row step-time percentiles. The laps are taken
+    WITHOUT per-dispatch fences (the timing-honesty contract above forbids
+    extra fences inside the timed region), so they measure dispatch
+    resolution; under device backpressure they converge to execution time,
+    and the row's mean (fenced once, at the fetch) remains the headline."""
     from tpuddp.training.step import stack_batches
 
     if scan > 1:
@@ -128,8 +130,13 @@ def _make_runner(ddp, state_box, batch, scan):
         def run(steps):
             outer = max(1, steps // scan)
             metrics = None
+            t_prev = time.perf_counter()
             for _ in range(outer):
                 state_box[0], metrics = ddp.train_step_many(state_box[0], stacked)
+                if laps is not None:
+                    t_now = time.perf_counter()
+                    laps.append((t_now - t_prev) / scan)
+                    t_prev = t_now
             loss_sum = float(np.sum(np.asarray(metrics["loss_sum"])))  # fence
             assert np.isfinite(loss_sum)
             return outer * scan
@@ -138,8 +145,13 @@ def _make_runner(ddp, state_box, batch, scan):
 
         def run(steps):
             metrics = None
+            t_prev = time.perf_counter()
             for _ in range(steps):
                 state_box[0], metrics = ddp.train_step(state_box[0], batch)
+                if laps is not None:
+                    t_now = time.perf_counter()
+                    laps.append(t_now - t_prev)
+                    t_prev = t_now
             loss_sum = float(np.sum(np.asarray(metrics["loss_sum"])))
             assert np.isfinite(loss_sum)
             return steps
@@ -184,9 +196,11 @@ def bench_config(
     batch = ddp.shard((x, y, w))
 
     state_box = [state]
-    run = _make_runner(ddp, state_box, batch, scan)
+    laps = []
+    run = _make_runner(ddp, state_box, batch, scan, laps=laps)
     run(max(3, scan))  # compile + stage all buffers (lazy-upload warm)
     run(max(3, scan))  # second warm pass: steady-state dispatch path
+    laps.clear()  # percentiles cover the timed region only
     t0 = time.perf_counter()
     steps = run(steps)
     dt = time.perf_counter() - t0
@@ -274,6 +288,19 @@ def bench_config(
             log(f"  augment flops probe failed ({type(e).__name__}: {e})")
     if flops_note:
         extra["mfu_note"] = flops_note
+    # step-time percentiles over the timed region's per-dispatch laps (the
+    # observability recorder's percentile code — one definition for bench
+    # rows and history.jsonl): a straggling dispatch or a mid-run slowdown
+    # shows up as a p95/p99 >> p50, invisible in the mean
+    if laps:
+        from tpuddp.observability import percentiles as _pct
+
+        pct = _pct(laps)
+        extra.update({
+            f"ms_per_step_{k}": round(v * 1e3, 3)
+            for k, v in pct.items() if v is not None
+        })
+        extra["timed_dispatches"] = len(laps)
     # per-step gradient-comm wire bytes (parallel/comm.py accounting): the
     # compressed hooks' byte reduction as a recorded bench artifact
     if ddp.grad_comm_bytes_per_step is not None:
@@ -540,7 +567,7 @@ def emit_summary(ours, baseline, out_path=None):
     )
     # strict JSON on disk: a non-finite row value (a failed/blown-up config)
     # lands as null, never the bare NaN token strict parsers reject
-    from tpuddp.utils.observability import json_sanitize
+    from tpuddp.observability import json_sanitize
 
     with open(path, "w") as f:
         json.dump(json_sanitize(payload), f, indent=2, allow_nan=False)
@@ -742,7 +769,7 @@ def main(argv=None):
     # parses exactly this line; the full per-config dict went to
     # bench_results.json inside emit_summary). Strict JSON: non-finite
     # values serialize as null, never a bare NaN token.
-    from tpuddp.utils.observability import json_sanitize
+    from tpuddp.observability import json_sanitize
 
     print(
         json.dumps(json_sanitize(emit_summary(ours, baseline)), allow_nan=False),

@@ -66,9 +66,15 @@ def run_train_worker(out_dir, epochs, env, timeout=300):
     )
 
 
-def history_epochs(out_dir):
+def history_records(out_dir):
     with open(os.path.join(str(out_dir), "history.jsonl")) as f:
-        return [json.loads(line)["epoch"] for line in f]
+        return [json.loads(line) for line in f]
+
+
+def history_epochs(out_dir):
+    # typed record stream (tpuddp/observability/schema.py): epoch progress is
+    # the `epoch`-type rows; run_meta headers and event rows ride alongside
+    return [r["epoch"] for r in history_records(out_dir) if r.get("type") == "epoch"]
 
 
 def test_sigterm_drain_then_auto_resume_round_trip(tmp_path):
@@ -104,6 +110,14 @@ def test_sigterm_drain_then_auto_resume_round_trip(tmp_path):
     path, interrupted_epoch = found
     assert integrity.verify_file(path)
     assert ckpt.read_meta(path)["completed"] == 0
+
+    # the drain's fsync'd event row survived the kill (MetricsWriter.sync on
+    # the preemption path): the interrupted run's LAST record is a complete
+    # preempt event — never a truncated line
+    records = history_records(tmp_path)
+    assert records[-1].get("event") == "preempt", records[-1]
+    assert records[-1]["epoch"] == interrupted_epoch
+    assert records[0].get("type") == "run_meta"
 
     resumed = run_train_worker(tmp_path, epochs=6, env=chaos_env(TPUDDP_AUTO_RESUME=1))
     assert resumed.returncode == 0, resumed.stdout[-2000:] + resumed.stderr[-2000:]
@@ -174,11 +188,13 @@ def test_nan_gradient_firewalled_end_to_end(tmp_path):
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "nan@step=12 fired" in proc.stdout + proc.stderr
-    rows = [
-        json.loads(line)
-        for line in open(os.path.join(str(tmp_path), "history.jsonl"))
-    ]
+    rows = [r for r in history_records(tmp_path) if r.get("type") == "epoch"]
     assert [r["epoch"] for r in rows] == [0, 1, 2, 3]
+    # the skip also landed as a typed event row next to the epoch fields
+    assert any(
+        r.get("event") == "skipped_updates" and r["epoch"] == 1
+        for r in history_records(tmp_path)
+    )
     by_epoch = {r["epoch"]: r for r in rows}
     assert by_epoch[1]["skipped_steps_epoch"] == 1
     assert by_epoch[0]["skipped_steps_epoch"] == 0
@@ -261,6 +277,16 @@ def test_hang_at_barrier_detected_by_watchdog(tmp_path):
         )
         assert "WORKER 0 armed" in out
         assert "stale" in err  # the watchdog named the dead peer before exiting
+        # heartbeat lag exported as a typed event record, fsync'd by the
+        # detector BEFORE its os._exit(76)
+        events = [
+            r for r in history_records(tmp_path)
+            if r.get("event") == "watchdog_stale"
+        ]
+        assert events, "no watchdog_stale event record written"
+        assert events[0]["process"] == 0
+        assert events[0]["stale_peers"][0]["process"] == 1
+        assert events[0]["stale_peers"][0]["lag_s"] >= timeout_s
     finally:
         hanger.kill()
         hanger.communicate(timeout=30)

@@ -197,8 +197,16 @@ def test_accelerate_entrypoint_observability_parity(tmp_path, capsys, monkeypatc
         json.loads(l)
         for l in open(tmp_path / "history.jsonl").read().splitlines()
     ]
-    assert len(lines) == 2
-    assert {"epoch", "train_loss", "test_loss", "test_accuracy"} <= set(lines[0])
+    # typed stream: a run_meta header opens the file, then one epoch row per
+    # epoch, each carrying the step recorder's percentile fields
+    assert lines[0]["type"] == "run_meta" and lines[0]["api"] == "managed"
+    epochs = [l for l in lines if l.get("type") == "epoch"]
+    assert len(epochs) == 2
+    assert {"epoch", "train_loss", "test_loss", "test_accuracy"} <= set(epochs[0])
+    assert epochs[0]["step_time_ms_p50"] is not None
+    from tpuddp.observability import schema as obs_schema
+
+    assert obs_schema.validate_history_records(lines) == []
 
     # NaN guard: a poisoned epoch must still write its post-mortem row
     # (record-before-check, native-driver parity) and then raise

@@ -704,5 +704,6 @@ def test_history_jsonl_is_strict_json(cpu_devices, tmp_path):
         json.loads(line, parse_constant=reject_nan)
         for line in raw.splitlines()
     ]
+    rows = [r for r in rows if r.get("type") == "epoch"]
     assert rows[0]["test_loss"] is None and rows[0]["test_accuracy"] is None
     assert np.isfinite(rows[0]["train_loss"])

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Run the FULL test gate — both tiers in one explicit invocation.
+"""Run the FULL test gate — both tiers plus the telemetry-schema gate.
 
 ``pytest.ini`` sets ``addopts = -m "not slow"``, so a bare ``pytest`` run is
 the fast tier-1 gate only: the subprocess/CLI end-to-end runs, the multichip
@@ -17,6 +17,12 @@ contracts are fast compiled-step assertions, not subprocess chaos; only the
 subprocess proofs (nan@step, exit-77, rollback in tests/test_chaos.py) live
 in the chaos tier.
 
+Schema gate (after the suites pass): a dryrun training subprocess produces a
+``history.jsonl`` and ``tools/tpuddp_inspect.py --validate`` must accept it;
+if a ``bench_results.json`` exists at the repo root, it is validated too. A
+writer drifting off the typed record schema (tpuddp/observability/schema.py)
+fails the gate here instead of corrupting downstream consumers.
+
 Usage: python tools/run_full_gate.py [extra pytest args]
 
 The two-tier contract is documented in README "Testing"; the chaos tier can
@@ -26,8 +32,58 @@ still be run alone via tools/run_chaos.py.
 import os
 import subprocess
 import sys
+import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _schema_gate(env) -> int:
+    """Dryrun-train, then validate the artifacts with tpuddp_inspect."""
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    with tempfile.TemporaryDirectory(prefix="tpuddp_gate_") as out_dir:
+        # the chaos suite's training worker IS the dryrun entry: the full
+        # native spawn path (4 virtual CPU devices, synthetic data) with the
+        # telemetry window armed so step_stats rows are exercised too
+        worker_env = dict(env)
+        worker_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            "TPUDDP_CHAOS_TRAINING": '{"step_stats_every": 4}',
+        })
+        rc = subprocess.call(
+            [
+                sys.executable, "-u",
+                os.path.join(REPO, "tests", "_chaos_train_worker.py"),
+                out_dir, "2",
+            ],
+            cwd=REPO, env=worker_env,
+        )
+        if rc != 0:
+            print(f"schema gate: dryrun training exited {rc}", file=sys.stderr)
+            return rc
+        rc = subprocess.call(
+            [sys.executable, inspect, "--validate",
+             os.path.join(out_dir, "history.jsonl")],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("schema gate: dryrun history.jsonl failed validation",
+                  file=sys.stderr)
+            return rc
+    bench_json = os.path.join(REPO, "bench_results.json")
+    if os.path.exists(bench_json):
+        rc = subprocess.call(
+            [sys.executable, inspect, "--validate", bench_json],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("schema gate: bench_results.json failed validation",
+                  file=sys.stderr)
+            return rc
+    else:
+        print("schema gate: no bench_results.json at repo root (skipped)")
+    return 0
 
 
 def main(argv=None):
@@ -39,7 +95,10 @@ def main(argv=None):
         "-p", "no:cacheprovider",
         *(argv if argv is not None else sys.argv[1:]),
     ]
-    return subprocess.call(cmd, cwd=REPO, env=env)
+    rc = subprocess.call(cmd, cwd=REPO, env=env)
+    if rc != 0:
+        return rc
+    return _schema_gate(env)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""tpuddp_inspect — validate and summarize tpuddp telemetry artifacts.
+
+Works on both machine-readable artifacts the framework writes:
+
+- ``history.jsonl`` (a training run's typed record stream:
+  ``run_meta`` / ``epoch`` / ``step_stats`` / ``event``,
+  tpuddp/observability/schema.py) — prints the run header, a per-epoch
+  table with step-time percentiles, the event timeline, and the
+  gradient-comm byte savings a compressed hook achieved;
+- ``bench_results.json`` (the bench harness's full per-config payload).
+
+Usage:
+    python tools/tpuddp_inspect.py <path> [--validate] [--events]
+
+``--validate`` checks the schema only (exit 0 valid / 1 invalid, errors on
+stderr) — the mode ``tools/run_full_gate.py`` runs over the dryrun history
+and the bench artifact, so schema drift fails a gate instead of corrupting
+downstream consumers. No flags: validate AND print the summary.
+
+The file kind is detected by content (a JSON-lines stream vs one JSON
+object), not by name, so renamed artifacts still inspect.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_schema():
+    """Load tpuddp/observability/schema.py by file path — NOT through the
+    tpuddp package, whose observability __init__ imports jax/numpy. The
+    validators are pure python, so this CLI stays usable on analysis hosts
+    where the accelerator runtime is absent."""
+    path = os.path.join(_REPO, "tpuddp", "observability", "schema.py")
+    spec = importlib.util.spec_from_file_location("_tpuddp_inspect_schema", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _detect_kind(path: str) -> str:
+    """'bench' (ONE JSON object with metric+configs — possibly
+    pretty-printed across lines) or 'history' (a JSONL record stream, which
+    fails whole-file json.load with 'Extra data' beyond one record)."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except ValueError:
+        return "history"
+    if isinstance(obj, dict) and "configs" in obj and "metric" in obj:
+        return "bench"
+    return "history"
+
+
+def _read_history(path: str):
+    records = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    records.append({"type": "<unparseable>"})
+    return records
+
+
+def _fmt(v, nd=4):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _print_table(rows, headers):
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    line = "  ".join(h.rjust(w) for h, w in zip(headers, widths))
+    print(line)
+    print("-" * len(line))
+    for r in rows:
+        print("  ".join(c.rjust(w) for c, w in zip(r, widths)))
+
+
+def summarize_history(path: str) -> None:
+    records = _read_history(path)
+    metas = [r for r in records if r.get("type") == "run_meta"]
+    epochs = [r for r in records if r.get("type") == "epoch"]
+    # legacy (pre-schema) histories: epoch rows are the ones with losses
+    if not epochs:
+        epochs = [r for r in records if "train_loss" in r]
+    events = [r for r in records if r.get("type") == "event" or (
+        "type" not in r and "event" in r)]
+    steps = [r for r in records if r.get("type") == "step_stats"]
+
+    if metas:
+        m = metas[-1]
+        print(f"run_meta ({len(metas)} header(s); newest):")
+        for k in (
+            "api", "model", "dataset", "config_hash", "mesh_shape",
+            "world_size", "process_count", "device_kind", "jax_version",
+            "tpuddp_version", "comm_hook", "scan_steps", "grad_accumulation",
+            "step_stats_every",
+        ):
+            if m.get(k) is not None:
+                print(f"  {k:>20}: {m[k]}")
+        guard = m.get("guard")
+        if isinstance(guard, dict) and guard.get("enabled"):
+            print(f"  {'guard':>20}: {guard}")
+    else:
+        print("run_meta: MISSING (pre-schema history?)")
+
+    if epochs:
+        print(f"\nepochs ({len(epochs)}):")
+        rows = []
+        for e in epochs:
+            rows.append([
+                str(e.get("epoch")),
+                _fmt(e.get("train_loss")),
+                _fmt(e.get("test_loss")),
+                _fmt(e.get("test_accuracy"), 2),
+                _fmt(e.get("epoch_time_s"), 1),
+                _fmt(e.get("samples_per_sec"), 0),
+                _fmt(e.get("step_time_ms_p50"), 2),
+                _fmt(e.get("step_time_ms_p95"), 2),
+                _fmt(e.get("step_time_ms_p99"), 2),
+                _fmt(e.get("mfu_p50")),
+                str(e.get("skipped_steps_epoch", 0) or 0),
+            ])
+        _print_table(rows, [
+            "ep", "train", "test", "acc%", "t(s)", "sps",
+            "p50ms", "p95ms", "p99ms", "mfu50", "skip",
+        ])
+        if steps:
+            print(f"\nstep_stats windows: {len(steps)} "
+                  f"(finest p99 {max(s.get('step_time_ms_p99') or 0 for s in steps):.2f} ms, "
+                  f"window size {steps[0].get('steps')})")
+
+    # gradient-comm byte savings: compressed vs the f32 baseline the header
+    # records; totals from the newest epoch's cumulative counter
+    if metas and epochs:
+        m = metas[-1]
+        per, base = m.get("grad_comm_bytes_per_update"), m.get(
+            "grad_comm_bytes_per_update_f32")
+        total = epochs[-1].get("grad_comm_bytes_total")
+        if per is not None and base:
+            saved = 1.0 - per / base
+            line = (f"\ngrad comm: {per:,} B/update on the wire vs {base:,} B "
+                    f"uncompressed ({saved * 100:.1f}% saved"
+                    f", hook {m.get('comm_hook')})")
+            if total is not None:
+                line += f"; {total:,} B total this run"
+            print(line)
+
+    if events:
+        print(f"\nevents ({len(events)}):")
+        for ev in events:
+            fields = {
+                k: v for k, v in ev.items()
+                if k not in ("type", "schema_version", "event")
+            }
+            print(f"  [{ev.get('epoch', '-')}] {ev.get('event')}: {fields}")
+    else:
+        print("\nevents: none")
+
+
+def summarize_bench(path: str) -> None:
+    with open(path) as f:
+        payload = json.load(f)
+    print(f"bench: {payload.get('metric')} = {payload.get('value')} "
+          f"{payload.get('unit')} on {payload.get('device')} "
+          f"(vs_baseline {payload.get('vs_baseline')} over "
+          f"{payload.get('vs_baseline_basis')})")
+    rows = []
+    for name, r in payload.get("configs", {}).items():
+        rows.append([
+            name,
+            _fmt(r.get("samples_per_sec_per_chip"), 0),
+            _fmt(r.get("ms_per_step"), 2),
+            _fmt(r.get("ms_per_step_p50"), 2),
+            _fmt(r.get("ms_per_step_p99"), 2),
+            _fmt(r.get("mfu")),
+        ])
+    _print_table(rows, ["config", "sps/chip", "ms", "p50ms", "p99ms", "mfu"])
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Validate/summarize a tpuddp history.jsonl or "
+        "bench_results.json artifact.",
+    )
+    parser.add_argument("path", help="artifact to inspect")
+    parser.add_argument(
+        "--validate", action="store_true",
+        help="schema check only: exit 0 when valid, 1 with errors on stderr",
+    )
+    parser.add_argument(
+        "--events", action="store_true",
+        help="print only the event timeline (history files)",
+    )
+    args = parser.parse_args(argv)
+
+    if not os.path.isfile(args.path):
+        print(f"no such file: {args.path}", file=sys.stderr)
+        return 2
+
+    schema = _load_schema()
+    kind = _detect_kind(args.path)
+    if kind == "bench":
+        errors, n = schema.validate_bench_file(args.path)
+    else:
+        errors, n = schema.validate_history_file(args.path)
+
+    if errors:
+        for e in errors:
+            print(f"INVALID: {e}", file=sys.stderr)
+        if args.validate:
+            return 1
+        print(f"({len(errors)} schema error(s) — summary follows)\n",
+              file=sys.stderr)
+    if args.validate:
+        print(f"OK: {args.path} — {n} {kind} record(s), schema v"
+              f"{schema.SCHEMA_VERSION}")
+        return 0
+
+    if kind == "bench":
+        summarize_bench(args.path)
+    elif args.events:
+        for r in _read_history(args.path):
+            if r.get("event"):
+                print(json.dumps(r))
+    else:
+        summarize_history(args.path)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

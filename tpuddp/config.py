@@ -81,6 +81,11 @@ TRAINING_DEFAULTS = {
     # strict no-op — the step lowers to the identical HLO.
     "synthetic_n": None,  # (train, test) sizes for the synthetic dataset /
     # fallback; None -> (2048, 512)
+    "step_stats_every": 0,  # telemetry window (tpuddp/observability): N > 0
+    # writes one `step_stats` record (step-time p50/p95/p99/max, samples/sec,
+    # MFU) to history.jsonl every N train steps — ONE host-side device fence
+    # per window, nothing in the compiled step. 0 (default) disables window
+    # rows; epoch rows always carry the full-epoch percentiles either way.
 }
 
 # Label-space size by dataset name; the reference hardcodes 10 because its only

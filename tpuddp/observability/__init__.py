@@ -1,0 +1,83 @@
+"""Observability — step-level telemetry, typed metrics schema, profiling.
+
+Promoted from ``tpuddp/utils/observability.py`` (which remains as a
+re-export shim) into a real subsystem once the ad-hoc JSONL writes outgrew
+their one file: resilience events (rollback/desync/preempt), comm-bytes
+accounting, and the bench harness all emit measurement artifacts, and
+pod-scale TPU work treats per-step timing and MFU accounting as first-class
+(MLPerf-on-TPU-pods, arxiv 1909.09756) rather than something grepped out of
+stdout.
+
+- :mod:`metrics`   — strict-JSON history writer (fsync-on-drain), comm-bytes
+  counter, ``json_sanitize``/``check_finite``.
+- :mod:`schema`    — the typed record schema (``run_meta``/``epoch``/
+  ``step_stats``/``event`` + ``schema_version``) and its validators, shared
+  by the writers and ``tools/tpuddp_inspect.py``.
+- :mod:`recorder`  — per-step wall-time ring buffer, p50/p95/p99/max +
+  achieved-MFU summaries, the chip peak-FLOPs table.
+- :mod:`profiling` — ``TPUDDP_PROFILE`` (first epoch),
+  ``TPUDDP_PROFILE_STEPS=<start>:<stop>`` (step window), SIGUSR1 (one epoch
+  on demand).
+- :mod:`telemetry` — :class:`RunTelemetry`, the bundle the epoch drivers
+  wire through their hot loops.
+"""
+
+from tpuddp.observability.metrics import (  # noqa: F401
+    CommBytesCounter,
+    MetricsWriter,
+    check_finite,
+    json_sanitize,
+    nan_checks_enabled,
+)
+from tpuddp.observability.profiling import (  # noqa: F401
+    install_sigusr1_trigger,
+    maybe_start_profiler,
+    parse_profile_steps,
+    stop_profiler,
+)
+from tpuddp.observability.recorder import (  # noqa: F401
+    PEAK_FLOPS,
+    StepStatsRecorder,
+    device_peak_flops,
+    estimate_step_flops,
+    percentiles,
+    step_time_fields,
+)
+from tpuddp.observability.schema import (  # noqa: F401
+    RECORD_TYPES,
+    SCHEMA_VERSION,
+    config_hash,
+    make_run_meta,
+    stamp,
+    validate_bench_file,
+    validate_history_file,
+    validate_history_records,
+)
+from tpuddp.observability.telemetry import RunTelemetry  # noqa: F401
+
+__all__ = [
+    "CommBytesCounter",
+    "MetricsWriter",
+    "PEAK_FLOPS",
+    "RECORD_TYPES",
+    "RunTelemetry",
+    "SCHEMA_VERSION",
+    "StepStatsRecorder",
+    "check_finite",
+    "config_hash",
+    "device_peak_flops",
+    "estimate_step_flops",
+    "install_sigusr1_trigger",
+    "json_sanitize",
+    "make_run_meta",
+    "maybe_start_profiler",
+    "nan_checks_enabled",
+    "parse_profile_steps",
+    "percentiles",
+    "stamp",
+    "step_time_fields",
+    "stop_profiler",
+    "validate_bench_file",
+    "validate_history_file",
+    "validate_history_records",
+]

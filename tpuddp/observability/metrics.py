@@ -1,0 +1,175 @@
+"""Metrics sinks — the JSONL history writer and the comm-bytes counter.
+
+``history.jsonl`` is the machine-readable record of a run (one typed JSON
+record per line; see :mod:`tpuddp.observability.schema`), written by
+process 0 next to the checkpoints. Every value passes through
+:func:`json_sanitize` + ``json.dumps(..., allow_nan=False)`` so the file is
+*strict* JSON on disk: a blown-up epoch's post-mortem row serializes its
+NaN/Inf metrics as ``null``, never as the bare tokens strict parsers (jq,
+serde, JSON.parse, BigQuery loads) reject.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+_NANS_ENV = "TPUDDP_DEBUG_NANS"
+
+
+def nan_checks_enabled() -> bool:
+    return os.environ.get(_NANS_ENV, "") not in ("", "0")
+
+
+def json_sanitize(value):
+    """Strict-JSON form of a record: non-finite floats become ``None``
+    (serialized ``null``), recursively through dicts/lists/tuples, and numpy
+    leaves (``np.float32``/``np.int64``/``np.bool_`` scalars and 0-d arrays —
+    a stray device scalar that leaked into a record) fail into clean Python
+    values instead of tripping ``allow_nan=False`` or emitting non-JSON reprs.
+
+    Python's ``json.dumps`` default emits bare ``NaN``/``Infinity`` tokens —
+    *invalid* JSON that strict parsers reject, which made ``history.jsonl``
+    and ``bench_results.json`` unconsumable the moment an epoch blew up (the
+    empty-test-loader path writes ``float("nan")`` test metrics by design).
+    Writers here pair this with ``json.dumps(..., allow_nan=False)`` so any
+    future non-finite leak fails loudly at write time instead of corrupting
+    the artifact."""
+    if isinstance(value, dict):
+        return {k: json_sanitize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [json_sanitize(v) for v in value]
+    # numpy scalars / 0-d arrays (incl. jax arrays fetched to host): .item()
+    # yields the native Python value, then the float rule below applies —
+    # np.bool_ must resolve before the generic test (it is not a Number json
+    # knows) and np.float32(nan) must land as null like any other NaN
+    if isinstance(value, np.generic):
+        value = value.item()
+    elif isinstance(value, np.ndarray) and value.ndim == 0:
+        value = value.item()
+    if isinstance(value, float) and not math.isfinite(value):
+        return None
+    return value
+
+
+def check_finite(value: float, what: str) -> None:
+    """Raise if a host-side aggregated metric went non-finite (only when
+    $TPUDDP_DEBUG_NANS is set)."""
+    if nan_checks_enabled() and not math.isfinite(value):
+        raise FloatingPointError(f"non-finite {what}: {value}")
+
+
+class CommBytesCounter:
+    """Running gradient-communication byte counter (per replica).
+
+    The per-update payload is static (compiled into the step program), so the
+    counter is host-side multiplication — free next to a device step. ``None``
+    bytes-per-update (a ddp object predating init_state, or an Accelerator
+    facade without the attribute) degrades to an inert counter whose
+    :meth:`snapshot` returns ``{}`` so epoch records stay unchanged. A true
+    ``0`` (a hookless / no-grad-comm configuration) is a *real measurement*
+    and stays 0 — it must not collapse into the inert None case, or a
+    zero-byte path would silently vanish from the record instead of being
+    reported as zero."""
+
+    def __init__(self, bytes_per_update):
+        self.bytes_per_update = (
+            int(bytes_per_update) if bytes_per_update is not None else None
+        )
+        self.updates = 0
+
+    def add_updates(self, n: int) -> None:
+        self.updates += int(n)
+
+    @property
+    def total_bytes(self):
+        if self.bytes_per_update is None:
+            return None
+        return self.bytes_per_update * self.updates
+
+    def snapshot(self, epoch_updates: int = None) -> dict:
+        """Record fields for the JSONL history: the static per-update payload,
+        the cumulative total, and (when given) this epoch's slice."""
+        if self.bytes_per_update is None:
+            return {}
+        out = {
+            "grad_comm_bytes_per_update": self.bytes_per_update,
+            "grad_comm_bytes_total": self.total_bytes,
+        }
+        if epoch_updates is not None:
+            out["grad_comm_bytes_epoch"] = self.bytes_per_update * int(epoch_updates)
+        return out
+
+
+class MetricsWriter:
+    """JSONL metrics sink (``history.jsonl`` in the run dir).
+
+    Holds one line-buffered append handle (opened lazily at the first record),
+    so the file always ends on a whole JSON record — a crash or preemption
+    mid-epoch must not truncate the machine-readable history. :meth:`sync`
+    additionally ``os.fsync``-s the file so a record survives an imminent
+    SIGKILL; :meth:`close` (called from the epoch driver's ``finally``) syncs
+    too, covering the preemption-drain path where the scheduler's kill lands
+    seconds after the emergency checkpoint.
+
+    ``main_only=True`` (the default) gates writing to process 0 — the normal
+    single-writer history contract. ``main_only=False`` lets any process
+    append (used by the watchdog, whose stale-peer event fires on whichever
+    process detected it); single-line appends below PIPE_BUF are atomic on
+    POSIX, so concurrent writers interleave whole records, never bytes."""
+
+    def __init__(
+        self,
+        save_dir: Optional[str],
+        filename: str = "history.jsonl",
+        main_only: bool = True,
+    ):
+        self.path = None
+        self._f = None
+        if save_dir is not None and (not main_only or jax.process_index() == 0):
+            os.makedirs(save_dir, exist_ok=True)
+            self.path = os.path.join(save_dir, filename)
+
+    def write(self, record: dict) -> None:
+        if self.path is None:
+            return
+        if self._f is None:
+            # line-buffered: every completed line reaches the OS immediately,
+            # without a per-write flush syscall pair
+            self._f = open(self.path, "a", buffering=1)
+        # strict JSON on disk: NaN/Inf metrics (a blown-up epoch's
+        # post-mortem row) serialize as null, never the bare NaN token
+        # strict parsers reject
+        self._f.write(json.dumps(json_sanitize(record), allow_nan=False) + "\n")
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+
+    def sync(self) -> None:
+        """Flush + fsync: force written records to disk *now*. Called on the
+        preemption-drain path (and by :meth:`close`) so the final event row
+        survives the SIGKILL that follows the grace window."""
+        if self._f is not None:
+            self._f.flush()
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass  # fsync is best-effort on exotic filesystems
+
+    def close(self) -> None:
+        if self._f is not None:
+            self.sync()
+            self._f.close()
+            self._f = None
+
+    def __del__(self):  # backstop for callers that never reach close()
+        try:
+            self.close()
+        except Exception:
+            pass

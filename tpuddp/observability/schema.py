@@ -1,0 +1,259 @@
+"""Typed record schema for ``history.jsonl`` (and the bench artifact).
+
+Every line of ``history.jsonl`` is one JSON object carrying ``type`` (one of
+:data:`RECORD_TYPES`) and ``schema_version``:
+
+- ``run_meta`` — the header row, written once at loop start (and again by a
+  resumed run appending to an existing file): mesh shape, process/replica
+  counts, jax/tpuddp versions, config hash, comm-hook mode, guard config.
+- ``epoch``    — one row per completed epoch: losses/accuracy/throughput plus
+  step-time percentiles and achieved-MFU fields from the step recorder.
+- ``step_stats`` — one row per recorder window (``training.step_stats_every``
+  steps) inside an epoch: the intra-epoch resolution that makes a 10x
+  step-time regression or a straggler *within* an epoch visible.
+- ``event``    — discrete occurrences: rollback, desync, preempt, skipped
+  updates, watchdog staleness, profiler captures.
+
+``tools/tpuddp_inspect.py --validate`` enforces this schema, so drift fails
+a gate instead of corrupting downstream consumers. The validators live here
+(not in the tool) so writer tests and the CLI share one definition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Dict, Iterable, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+RECORD_TYPES = ("run_meta", "epoch", "step_stats", "event")
+
+# Required keys per record type (beyond the envelope's type/schema_version).
+# Values may be null where a metric can legitimately blow up (strict-JSON
+# post-mortem rows) or be unknowable (MFU without a known chip peak).
+_REQUIRED = {
+    "run_meta": (
+        "jax_version",
+        "tpuddp_version",
+        "world_size",
+        "process_count",
+        "process_index",
+        "mesh_shape",
+        "comm_hook",
+        "guard",
+    ),
+    "epoch": (
+        "epoch",
+        "train_loss",
+        "test_loss",
+        "test_accuracy",
+        "train_samples",
+        "test_samples",
+        "epoch_time_s",
+        "samples_per_sec",
+        "step_time_ms_p50",
+        "step_time_ms_p95",
+        "step_time_ms_p99",
+        "step_time_ms_max",
+        "mfu_p50",
+    ),
+    "step_stats": (
+        "epoch",
+        "step_start",
+        "steps",
+        "step_time_ms_p50",
+        "step_time_ms_p95",
+        "step_time_ms_p99",
+        "step_time_ms_max",
+        "samples_per_sec",
+    ),
+    "event": ("event",),
+}
+
+
+def stamp(record_type: str, record: dict) -> dict:
+    """Return ``record`` wrapped in the schema envelope (type first, so the
+    line is eyeball-able with ``head``)."""
+    if record_type not in RECORD_TYPES:
+        raise ValueError(
+            f"unknown record type {record_type!r}; expected one of {RECORD_TYPES}"
+        )
+    return {"type": record_type, "schema_version": SCHEMA_VERSION, **record}
+
+
+def config_hash(training: Optional[dict]) -> Optional[str]:
+    """Stable short hash of a training-config mapping — the run_meta field
+    that answers "were these two runs the same configuration?" without
+    embedding the whole config in every history file."""
+    if not training:
+        return None
+    canon = json.dumps(training, sort_keys=True, default=str)
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+def make_run_meta(
+    *,
+    mesh=None,
+    world_size: Optional[int] = None,
+    comm_hook: Optional[str] = None,
+    guard=None,
+    extra: Optional[dict] = None,
+) -> dict:
+    """Build the run_meta header row from live run objects.
+
+    ``mesh`` is a ``jax.sharding.Mesh`` (or None); ``guard`` is a
+    ``GuardConfig``/dict/None; ``extra`` carries entrypoint-level fields
+    (config_hash, model, dataset, scan_steps, ...)."""
+    import jax
+
+    import tpuddp
+
+    mesh_shape: Optional[Dict[str, int]] = None
+    device_kind = None
+    if mesh is not None:
+        mesh_shape = {
+            str(name): int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)
+        }
+        if world_size is None:
+            world_size = int(mesh.devices.size)
+        # the device actually running the step — NOT jax.devices()[0], which
+        # reports whatever platform happens to be default on this host (a
+        # CPU-ladder run on a TPU-attached host, or vice versa, would lie)
+        device_kind = mesh.devices.flat[0].device_kind
+    elif jax.devices():
+        device_kind = jax.devices()[0].device_kind
+    if dataclasses.is_dataclass(guard):
+        guard = dataclasses.asdict(guard)
+    record = {
+        "jax_version": jax.__version__,
+        "tpuddp_version": tpuddp.__version__,
+        "world_size": world_size,
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "mesh_shape": mesh_shape,
+        "device_kind": device_kind,
+        "comm_hook": comm_hook,
+        "guard": guard,
+    }
+    if extra:
+        record.update(extra)
+    return stamp("run_meta", record)
+
+
+# ------------------------------------------------------------- validation --
+
+
+def validate_record(record, index: int = 0) -> List[str]:
+    """Schema errors for one history record (empty list = valid)."""
+    where = f"record {index}"
+    if not isinstance(record, dict):
+        return [f"{where}: not a JSON object"]
+    errors = []
+    rtype = record.get("type")
+    if rtype not in RECORD_TYPES:
+        return [f"{where}: unknown type {rtype!r} (expected one of {RECORD_TYPES})"]
+    version = record.get("schema_version")
+    if not isinstance(version, int) or version < 1:
+        errors.append(f"{where}: schema_version {version!r} is not a positive int")
+    elif version > SCHEMA_VERSION:
+        errors.append(
+            f"{where}: schema_version {version} is newer than this reader's "
+            f"{SCHEMA_VERSION}"
+        )
+    missing = [k for k in _REQUIRED[rtype] if k not in record]
+    if missing:
+        errors.append(f"{where} ({rtype}): missing required field(s) {missing}")
+    if rtype == "event" and not isinstance(record.get("event"), str):
+        errors.append(f"{where} (event): 'event' must be a string")
+    if rtype == "run_meta":
+        shape = record.get("mesh_shape")
+        if shape is not None and not isinstance(shape, dict):
+            errors.append(f"{where} (run_meta): mesh_shape must be an object or null")
+    return errors
+
+
+def validate_history_records(records: Iterable[dict]) -> List[str]:
+    """Schema errors for a whole history (empty list = valid).
+
+    The FIRST record must be ``run_meta``; later ``run_meta`` rows are legal
+    (a resumed run appends a fresh header before its epochs)."""
+    errors: List[str] = []
+    n = 0
+    for i, record in enumerate(records):
+        n += 1
+        if i == 0 and (
+            not isinstance(record, dict) or record.get("type") != "run_meta"
+        ):
+            errors.append(
+                "record 0: history must start with a run_meta header row, got "
+                f"type {record.get('type') if isinstance(record, dict) else record!r}"
+            )
+        errors.extend(validate_record(record, i))
+    if n == 0:
+        errors.append("empty history: no records")
+    return errors
+
+
+def validate_history_file(path: str) -> Tuple[List[str], int]:
+    """Parse + validate a ``history.jsonl`` file. Returns (errors, n_records).
+    Non-strict JSON (bare NaN/Infinity tokens) is itself a schema error."""
+
+    def _reject(token):
+        raise ValueError(f"non-strict JSON token {token}")
+
+    errors: List[str] = []
+    records = []
+    try:
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                if not line.strip():
+                    continue
+                try:
+                    records.append(json.loads(line, parse_constant=_reject))
+                except ValueError as e:
+                    errors.append(f"line {lineno}: invalid JSON ({e})")
+    except OSError as e:
+        return [f"cannot read {path}: {e}"], 0
+    errors.extend(validate_history_records(records))
+    return errors, len(records)
+
+
+# Bench artifact (bench_results.json) — a single JSON object, not JSONL.
+_BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline", "device", "configs")
+_BENCH_ROW_REQUIRED = ("samples_per_sec_per_chip", "ms_per_step")
+
+
+def validate_bench_payload(payload) -> List[str]:
+    """Schema errors for a ``bench_results.json`` payload (empty = valid)."""
+    if not isinstance(payload, dict):
+        return ["bench payload is not a JSON object"]
+    errors = [f"missing field {k!r}" for k in _BENCH_REQUIRED if k not in payload]
+    configs = payload.get("configs")
+    if not isinstance(configs, dict):
+        errors.append("'configs' must be an object of name -> row")
+        return errors
+    for name, row in configs.items():
+        if not isinstance(row, dict):
+            errors.append(f"config {name!r}: not an object")
+            continue
+        missing = [k for k in _BENCH_ROW_REQUIRED if k not in row]
+        if missing:
+            errors.append(f"config {name!r}: missing field(s) {missing}")
+    return errors
+
+
+def validate_bench_file(path: str) -> Tuple[List[str], int]:
+    def _reject(token):
+        raise ValueError(f"non-strict JSON token {token}")
+
+    try:
+        with open(path) as f:
+            payload = json.load(f, parse_constant=_reject)
+    except (OSError, ValueError) as e:
+        return [f"cannot parse {path}: {e}"], 0
+    errors = validate_bench_payload(payload)
+    n = len(payload.get("configs", {})) if isinstance(payload, dict) else 0
+    return errors, n

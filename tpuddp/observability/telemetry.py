@@ -1,0 +1,156 @@
+"""RunTelemetry — the one object an epoch driver wires through its hot loop.
+
+Bundles the step recorder (:mod:`recorder`), the $TPUDDP_PROFILE_STEPS
+window profiler and the SIGUSR1 epoch-trace trigger (:mod:`profiling`)
+behind two per-dispatch calls:
+
+    tel.pre_dispatch(n_steps)                  # before issuing the dispatch
+    tel.post_dispatch(n_steps, n_samples, m)   # after, m = its output pytree
+
+plus ``start_epoch``/``end_epoch`` at epoch boundaries and ``finish`` in the
+driver's ``finally``. Everything is host-side: the compiled step program is
+never touched (telemetry on/off lowers to the identical HLO), no collectives
+are added, and the only device syncs are the per-window fence and the
+profiler's end-of-window flush.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from tpuddp.observability import profiling
+from tpuddp.observability.recorder import StepStatsRecorder, estimate_step_flops
+
+
+class _NullTelemetry:
+    """Inert stand-in so hot loops call the hooks unconditionally — a
+    dispatch site can never forget a ``tel is not None`` guard because
+    there is none."""
+
+    def offer_batch(self, host_batch) -> None:
+        pass
+
+    def pre_dispatch(self, n_steps: int) -> None:
+        pass
+
+    def post_dispatch(self, n_steps: int, n_samples: int, fence=None) -> None:
+        pass
+
+    def start_epoch(self, epoch: int) -> None:
+        pass
+
+    def end_epoch(self) -> dict:
+        return {}
+
+    def finish(self) -> None:
+        pass
+
+
+NULL = _NullTelemetry()
+
+
+class RunTelemetry:
+    def __init__(
+        self,
+        writer=None,
+        save_dir: Optional[str] = None,
+        step_stats_every: int = 0,
+        world_size: int = 1,
+        flops_lower_fn: Optional[Callable] = None,
+        device_kind: Optional[str] = None,
+    ):
+        """``flops_lower_fn``: zero-arg callable returning the lowered
+        single-step program, used once (lazily, failure-tolerant) to resolve
+        per-step FLOPs for the MFU fields; None leaves MFU null.
+        ``device_kind``: the MESH device's kind (for the peak-FLOPs lookup)
+        — pass it so a CPU-ladder run on a TPU-attached host (or the
+        reverse) reports MFU against the right ceiling."""
+        from tpuddp.observability.recorder import device_peak_flops
+
+        self.recorder = StepStatsRecorder(
+            writer=writer,
+            window=step_stats_every,
+            peak_flops=device_peak_flops(device_kind),
+        )
+        self.window_profiler = profiling.StepWindowProfiler(save_dir)
+        self.writer = writer
+        self.save_dir = save_dir
+        self.world_size = max(1, int(world_size))
+        self.flops_lower_fn = flops_lower_fn
+        self.batch_struct = None
+        self._flops_probed = False
+        self._epoch_trace = False
+        self._last_fence = None
+        profiling.install_sigusr1_trigger()
+
+    def offer_batch(self, host_batch) -> None:
+        """Capture the abstract (shape, dtype) structure of one host batch —
+        the FLOPs probe lowers the step program against it later. Reads only
+        array metadata; nothing is copied or placed."""
+        if self.batch_struct is not None:
+            return
+        try:
+            import jax
+            import numpy as np
+
+            self.batch_struct = tuple(
+                jax.ShapeDtypeStruct(np.shape(b), np.asarray(b).dtype)
+                for b in host_batch
+            )
+        except Exception:  # metadata-only best effort; MFU stays null
+            self.batch_struct = ()
+
+    # -- hot-loop hooks (cheap: integer compares + perf_counter) -----------
+
+    def pre_dispatch(self, n_steps: int) -> None:
+        self.window_profiler.before_dispatch(self.recorder.global_step, n_steps)
+
+    def post_dispatch(self, n_steps: int, n_samples: int, fence=None) -> None:
+        self._last_fence = fence
+        self.recorder.record(n_steps, n_samples, fence=fence)
+        self.window_profiler.after_dispatch(self.recorder.global_step, fence)
+
+    # -- epoch boundaries --------------------------------------------------
+
+    def start_epoch(self, epoch: int) -> None:
+        self.recorder.start_epoch(epoch)
+        if profiling.consume_sigusr1_request():
+            self._epoch_trace = profiling.start_epoch_trace(self.save_dir, epoch)
+            if self._epoch_trace and self.writer is not None:
+                from tpuddp.observability import schema
+
+                self.writer.write(
+                    schema.stamp(
+                        "event", {"event": "profile_epoch", "epoch": epoch}
+                    )
+                )
+
+    def stop_epoch_trace(self) -> None:
+        """Flush an active SIGUSR1 epoch trace. Runs inside :meth:`end_epoch`
+        by default; a driver whose train summary happens BEFORE evaluation
+        (the managed loop) passes ``stop_trace=False`` there and calls this
+        after eval, so the 'trace the next epoch' contract covers the whole
+        epoch on both drivers."""
+        if self._epoch_trace:
+            profiling.stop_profiler()
+            self._epoch_trace = False
+
+    def end_epoch(self, stop_trace: bool = True) -> dict:
+        """Step-time/MFU fields for the epoch's history row (call after the
+        epoch's metric fetch — the device is already fenced there)."""
+        if stop_trace:
+            self.stop_epoch_trace()
+        if not self._flops_probed and self.flops_lower_fn is not None:
+            # once per run, at the FIRST epoch boundary (never in the hot
+            # loop): lowering traces the step but compiles/executes nothing
+            self._flops_probed = True
+            self.recorder.flops_per_step = estimate_step_flops(
+                self.flops_lower_fn, self.world_size
+            )
+        return self.recorder.epoch_summary()
+
+    def finish(self) -> None:
+        """Driver ``finally``: flush any partial step-window trace (it is the
+        post-mortem artifact) and release the trace latch."""
+        self.window_profiler.finish(self._last_fence)
+        self.stop_epoch_trace()

@@ -128,6 +128,7 @@ class DistributedDataParallel:
         self.guard = guard_lib.resolve_guard(guard)
         self._comm = None
         self._grad_comm_bytes = None
+        self._grad_comm_bytes_f32 = None
         self._wus_spec = None
         self._state_spec = None
         self._train_step = None
@@ -196,6 +197,14 @@ class DistributedDataParallel:
             wus=self.weight_update_sharding,
             # auto mode: XLA inserts the psum over f32 values and the hook
             # only emulates the quantization — account the wire honestly
+            wire=(self.mode == "shard_map"),
+        )
+        # the uncompressed reference payload for the same layout: run_meta
+        # records both, so a history file alone can state the byte savings
+        # a compressed hook achieved (tools/tpuddp_inspect.py)
+        self._grad_comm_bytes_f32 = comm_lib.comm_bytes_for_hook(
+            state.params, self.world_size, "none",
+            wus=self.weight_update_sharding,
             wire=(self.mode == "shard_map"),
         )
         sharded_residual = (
@@ -333,6 +342,14 @@ class DistributedDataParallel:
         :meth:`init_state`; None before. The epoch driver and bench multiply
         by optimizer updates to report measured comm volume."""
         return self._grad_comm_bytes
+
+    @property
+    def grad_comm_bytes_per_step_f32(self) -> Optional[int]:
+        """What one gradient reduction WOULD cost uncompressed (hook="none",
+        same layout) — the denominator of a compressed hook's byte-savings
+        claim, recorded in the run_meta header so the history file is
+        self-contained evidence."""
+        return self._grad_comm_bytes_f32
 
     def train_step_many(self, state: TrainState, stacked_batch):
         """K fused train steps per dispatch (lax.scan; see

@@ -145,7 +145,18 @@ def start(
         )
         return None
     hb = Heartbeat(directory, process_id, interval=interval).start()
-    wd = Watchdog(directory, process_id, num_processes, timeout).start()
+    # heartbeat-lag telemetry: the stale-peer verdict lands in history.jsonl
+    # as a typed event row, written by WHICHEVER process detected it (the
+    # single-writer process-0 gate does not apply — process 0 may be the dead
+    # one) and fsync'd before the exit that follows
+    event_writer = None
+    if save_dir is not None:
+        from tpuddp.observability import MetricsWriter
+
+        event_writer = MetricsWriter(save_dir, main_only=False)
+    wd = Watchdog(
+        directory, process_id, num_processes, timeout, event_writer=event_writer
+    ).start()
     logger.info(
         "watchdog armed: %d-process heartbeat dir %s, timeout %.1fs",
         num_processes,
@@ -181,6 +192,7 @@ class Watchdog:
         timeout: float,
         action: Union[str, Callable] = "exit",
         interval: Optional[float] = None,
+        event_writer=None,
     ):
         self.directory = directory
         self.process_id = int(process_id)
@@ -188,6 +200,10 @@ class Watchdog:
         self.timeout = float(timeout)
         self.action = action
         self.interval = float(interval) if interval else max(0.25, self.timeout / 4.0)
+        # observability.MetricsWriter (or None): stale-peer verdicts become
+        # typed event records in history.jsonl before the exit
+        self.event_writer = event_writer
+        self.max_observed_lag = 0.0
         self._started_at = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -208,6 +224,8 @@ class Watchdog:
                     stale.append((peer, now - started))
             elif now - beat > self.timeout:
                 stale.append((peer, now - beat))
+            else:
+                self.max_observed_lag = max(self.max_observed_lag, now - beat)
         return stale
 
     def start(self) -> "Watchdog":
@@ -237,6 +255,36 @@ class Watchdog:
             self.timeout,
             desc,
         )
+        if self.event_writer is not None:
+            # the verdict as a typed history record, fsync'd before os._exit
+            # (which skips every atexit/finally on purpose) can eat it
+            try:
+                from tpuddp.observability import make_run_meta, stamp
+
+                path = self.event_writer.path
+                if path is not None and (
+                    not os.path.exists(path) or os.path.getsize(path) == 0
+                ):
+                    # this process died before any driver wrote the header
+                    # (e.g. process 0 hung in rendezvous): the schema says
+                    # run_meta comes first, and the post-mortem must still
+                    # validate — write a minimal header before the event
+                    self.event_writer.write(make_run_meta(
+                        extra={"api": "watchdog", "process": self.process_id}
+                    ))
+                self.event_writer.write(stamp("event", {
+                    "event": "watchdog_stale",
+                    "process": self.process_id,
+                    "timeout_s": self.timeout,
+                    "stale_peers": [
+                        {"process": p, "lag_s": round(age, 3)}
+                        for p, age in stale
+                    ],
+                    "max_observed_lag_s": round(self.max_observed_lag, 3),
+                }))
+                self.event_writer.sync()
+            except Exception:
+                logger.exception("watchdog event record failed")
         if callable(self.action):
             self.action(stale)
         elif self.action == "raise":

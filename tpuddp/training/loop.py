@@ -33,15 +33,19 @@ from tpuddp.resilience.preemption import (
     auto_resume_requested,
     preemption_requested,
 )
-from tpuddp.training import checkpoint as ckpt
-from tpuddp.training.step import accumulate_metrics, finalize_metrics, stack_batches
-from tpuddp.utils.observability import (
+from tpuddp.observability import (
     CommBytesCounter,
     MetricsWriter,
+    RunTelemetry,
     check_finite,
+    make_run_meta,
     maybe_start_profiler,
+    stamp,
     stop_profiler,
 )
+from tpuddp.observability import telemetry as telemetry_lib
+from tpuddp.training import checkpoint as ckpt
+from tpuddp.training.step import accumulate_metrics, finalize_metrics, stack_batches
 
 logger = logging.getLogger("tpuddp")
 
@@ -119,7 +123,7 @@ def _never():
 
 def _fused_pass(
     ddp, state, loader, scan_k: int, step_one, step_many, probe_cb=None,
-    accum: int = 1, poll=preemption_requested, inject_cb=None,
+    accum: int = 1, poll=preemption_requested, inject_cb=None, tel=None,
 ):
     """One pass over ``loader`` with K-fused dispatch + one-chunk upload
     lookahead (device_put is async, so staging chunk N+1 before dispatching N
@@ -136,46 +140,66 @@ def _fused_pass(
     step collectives would wedge the pod, so the drain decision moves to the
     epoch boundary where it can be agreed globally. ``inject_cb`` (the
     ``nan@step=N`` chaos hook) may rewrite each host batch before it is
-    staged — wired only while an un-fired nan fault is armed."""
+    staged — wired only while an un-fired nan fault is armed. ``tel`` (a
+    :class:`~tpuddp.observability.RunTelemetry`; None -> inert) brackets
+    each dispatch with its host-side pre/post hooks — per-step wall times
+    and the $TPUDDP_PROFILE_STEPS window, never touching the compiled
+    program."""
+    if tel is None:
+        tel = telemetry_lib.NULL  # every dispatch site hooks unconditionally
     acc = None
     chunk = []
     staged = None
+    staged_samples = 0
     for batch_idx, host_batch in enumerate(loader):
         if inject_cb is not None:
             host_batch = inject_cb(host_batch)
         if probe_cb is not None:
             probe_cb(batch_idx, host_batch)
+        tel.offer_batch(host_batch)
         if poll():
             return state, acc, True
         if scan_k <= 1 and accum <= 1:
+            tel.pre_dispatch(1)
             state, metrics = step_one(state, ddp.shard(host_batch))
             acc = accumulate_metrics(acc, metrics)
+            tel.post_dispatch(1, len(host_batch[1]), metrics)
             continue
         chunk.append(host_batch)
         if len(chunk) == scan_k:
+            next_samples = sum(len(b[1]) for b in chunk)
             next_staged = ddp.shard_stacked(stack_batches(chunk))
             chunk = []
             if staged is not None:
+                tel.pre_dispatch(scan_k)
                 state, metrics = step_many(state, staged)
                 acc = accumulate_metrics(acc, metrics)
-            staged = next_staged
+                tel.post_dispatch(scan_k, staged_samples, metrics)
+            staged, staged_samples = next_staged, next_samples
     if poll():
         return state, acc, True
     if staged is not None:
+        tel.pre_dispatch(scan_k)
         state, metrics = step_many(state, staged)
         acc = accumulate_metrics(acc, metrics)
+        tel.post_dispatch(scan_k, staged_samples, metrics)
     if chunk and accum > 1:
         # tail under accumulation: pad to whole cycles, one scan dispatch
         # (a per-batch step would fire a full-scale update per micro-batch)
+        tail_samples = sum(len(b[1]) for b in chunk)
         tail = _pad_to_cycles(chunk, accum)
+        tel.pre_dispatch(len(tail))
         state, metrics = step_many(state, ddp.shard_stacked(stack_batches(tail)))
         acc = accumulate_metrics(acc, metrics)
+        tel.post_dispatch(len(tail), tail_samples, metrics)
         return state, acc, poll()
     for host_batch in chunk:  # remainder: single steps, same semantics
         if poll():
             return state, acc, True
+        tel.pre_dispatch(1)
         state, metrics = step_one(state, ddp.shard(host_batch))
         acc = accumulate_metrics(acc, metrics)
+        tel.post_dispatch(1, len(host_batch[1]), metrics)
     return state, acc, poll()
 
 
@@ -195,6 +219,8 @@ def run_training_loop(
     per_replica_log: bool = False,
     auto_resume: bool = False,
     keep_last: Optional[int] = None,
+    step_stats_every: int = 0,
+    run_meta: Optional[dict] = None,
     log=print,
 ):
     """Run the full training loop; returns ``(state, history)`` where history
@@ -221,6 +247,16 @@ def run_training_loop(
     ``guard.max_consecutive_skips`` updates were skipped back to back, and
     guards BOTH aggregated losses (``$TPUDDP_DEBUG_NANS``) before any
     checkpoint so a poisoned epoch can never persist its state.
+
+    Telemetry (tpuddp.observability): ``history.jsonl`` opens with a typed
+    ``run_meta`` header, every epoch row carries step-time p50/p95/p99/max
+    and achieved-MFU fields from the per-dispatch step recorder, and
+    ``step_stats_every=N > 0`` additionally emits one ``step_stats`` row per
+    N train steps (ONE host-side device fence per window — the compiled step
+    program is untouched). ``run_meta`` (the dict) merges entrypoint-level
+    fields (config hash, model, dataset) into the header row. Profiling:
+    ``$TPUDDP_PROFILE`` (first epoch), ``$TPUDDP_PROFILE_STEPS=a:b`` (step
+    window), SIGUSR1 (trace the next epoch of a live run).
     """
     is_main = jax.process_index() == 0
     pbytes = _param_bytes(state.params) if hasattr(state, "params") else None
@@ -281,6 +317,60 @@ def run_training_loop(
     # config; the driver owns the epoch-level policy — skip accounting,
     # periodic desync audits, rollback-to-last-good.
     guard_cfg = guard_lib.resolve_guard(getattr(ddp, "guard", None))
+
+    # ---- telemetry (tpuddp.observability): typed run_meta header first,
+    # then the per-dispatch step recorder + on-demand profiling triggers.
+    metrics_writer.write(make_run_meta(
+        mesh=getattr(ddp, "mesh", None),
+        world_size=getattr(ddp, "world_size", None),
+        comm_hook=getattr(ddp, "comm_hook", None),
+        guard=guard_cfg,
+        extra={
+            "api": "native",
+            "scan_steps": scan_steps,
+            "grad_accumulation": accum,
+            "start_epoch": start_epoch,
+            "num_epochs": num_epochs,
+            "step_stats_every": int(step_stats_every or 0),
+            "grad_comm_bytes_per_update": getattr(
+                ddp, "grad_comm_bytes_per_step", None
+            ),
+            "grad_comm_bytes_per_update_f32": getattr(
+                ddp, "grad_comm_bytes_per_step_f32", None
+            ),
+            **(run_meta or {}),
+        },
+    ))
+    # FLOPs probe for the MFU fields: lower (never compile) the single-step
+    # program once, at the first epoch boundary — only when the per-batch
+    # step exists (grad accumulation refuses it) and shapes are capturable.
+    flops_lower_fn = None
+    if accum == 1 and hasattr(ddp, "train_step"):
+        try:
+            state_struct = jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), state
+            )
+        except Exception:
+            state_struct = None
+        if state_struct is not None:
+            def flops_lower_fn():
+                if not tel.batch_struct:
+                    raise ValueError("no batch structure captured")
+                return jax.jit(
+                    lambda s, b: ddp.train_step(s, b)
+                ).lower(state_struct, tel.batch_struct)
+    ddp_mesh = getattr(ddp, "mesh", None)
+    tel = RunTelemetry(
+        writer=metrics_writer,
+        save_dir=save_dir,
+        step_stats_every=step_stats_every,
+        world_size=getattr(ddp, "world_size", 1) or 1,
+        flops_lower_fn=flops_lower_fn,
+        device_kind=(
+            ddp_mesh.devices.flat[0].device_kind if ddp_mesh is not None else None
+        ),
+    )
+
     prev_total_skips = (
         guard_lib.read_skip_counters(state)[0] if guard_cfg.enabled else 0
     )
@@ -300,12 +390,12 @@ def run_training_loop(
                 "known-good state — a systematic divergence, not a transient."
             )
         restored, redo_epoch = ckpt.restore_latest(save_dir, cur_state)
-        metrics_writer.write({
+        metrics_writer.write(stamp("event", {
             "event": "rollback",
             "epoch": epoch,
             "resume_epoch": redo_epoch,
             "reason": reason,
-        })
+        }))
         if is_main:
             log(
                 f"Guard rollback ({reason}): restored last-good checkpoint, "
@@ -357,6 +447,15 @@ def run_training_loop(
             path = ckpt.save_on_main(save_dir, epoch, state, completed=completed)
             if is_main:
                 log(f"Preempted: emergency checkpoint for epoch {epoch} saved.")
+        # the drain's event row, fsync'd NOW: the SIGKILL that follows the
+        # grace window must not be able to eat the post-mortem record
+        metrics_writer.write(stamp("event", {
+            "event": "preempt",
+            "epoch": epoch,
+            "completed": bool(completed),
+            "step": tel.recorder.global_step,
+        }))
+        metrics_writer.sync()
         raise TrainingPreempted(epoch, path)
 
     if is_main:
@@ -381,9 +480,10 @@ def run_training_loop(
                 # the periodic re-run of the wrap-time verify
                 bad_leaf = guard_lib.audit_params(ddp.mesh, state.params)
                 if bad_leaf is not None:
-                    metrics_writer.write(
-                        {"event": "desync", "epoch": epoch, "leaf": bad_leaf}
-                    )
+                    metrics_writer.write(stamp(
+                        "event",
+                        {"event": "desync", "epoch": epoch, "leaf": bad_leaf},
+                    ))
                     if guard_cfg.on_desync == "rollback" and can_roll_back():
                         state, epoch = rollback_to_last_good(
                             state, epoch, f"replica desync at leaf {bad_leaf}"
@@ -396,6 +496,7 @@ def run_training_loop(
                         bad_leaf, where=f"epoch {epoch} audit"
                     )
             t0 = time.perf_counter()
+            tel.start_epoch(epoch)
             if is_main:
                 log(f"Process {jax.process_index()}, Epoch {epoch}")
             if set_epoch:
@@ -420,7 +521,7 @@ def run_training_loop(
             state, train_acc, interrupted = _fused_pass(
                 ddp, state, train_loader, scan_steps,
                 ddp.train_step, ddp.train_step_many, probe_cb=train_probe,
-                accum=accum, poll=poll, inject_cb=nan_inject,
+                accum=accum, poll=poll, inject_cb=nan_inject, tel=tel,
             )
             if interrupted:
                 emergency_stop(epoch)
@@ -511,6 +612,10 @@ def run_training_loop(
                 "epoch_time_s": epoch_time,
                 "samples_per_sec": (train_m["n"] + eval_m["n"]) / max(epoch_time, 1e-9),
             }
+            # step-time percentiles + achieved-MFU from the train-pass
+            # recorder (the finalize_metrics fetch above already fenced the
+            # device, so the aggregate wall time is honest)
+            record.update(tel.end_epoch())
             record.update(comm_counter.snapshot(epoch_updates))
 
             # ---- guard skip accounting: ONE tiny counter fetch per epoch.
@@ -522,8 +627,18 @@ def run_training_loop(
                 record["skipped_steps"] = total_skips
                 record["skipped_steps_epoch"] = epoch_skips
 
+            record = stamp("epoch", record)
             history.append(record)
             metrics_writer.write(record)  # post-mortem row always lands
+            if epoch_skips:
+                # the firewall's skips as a discrete event next to the epoch
+                # fields, so event timelines see them without scanning rows
+                metrics_writer.write(stamp("event", {
+                    "event": "skipped_updates",
+                    "epoch": epoch,
+                    "count": epoch_skips,
+                    "total": record["skipped_steps"],
+                }))
             # $TPUDDP_DEBUG_NANS: BOTH aggregated losses are guarded BEFORE
             # any checkpoint below — a poisoned epoch must never persist its
             # state (the pre-fix ordering only checked the train loss, so a
@@ -581,6 +696,7 @@ def run_training_loop(
         # An exception mid-epoch (preemption, NaN guard, a worker crash) must
         # not lose the trace — it is the post-mortem artifact — nor leave the
         # JSONL metrics record unflushed/truncated.
+        tel.finish()
         stop_profiler()
         metrics_writer.close()
 

@@ -1,4 +1,6 @@
-"""Utility subsystems: observability (tracing/profiling/metrics), debugging."""
+"""Utility subsystems: compat shims, debugging. (Observability graduated to
+the ``tpuddp.observability`` package; the re-exports below keep old import
+paths working.)"""
 
 from tpuddp.utils.observability import (  # noqa: F401
     MetricsWriter,

@@ -1,172 +1,25 @@
-"""Observability — the auxiliary subsystems the reference only gestures at
-(SURVEY.md §5):
+"""Compatibility shim — the observability subsystem moved to
+``tpuddp.observability`` (a real package: typed record schema, step-level
+telemetry recorder, on-demand profiling, strict-JSON writers). This module
+re-exports the original names so pre-existing imports keep working; new code
+should import from :mod:`tpuddp.observability` directly."""
 
-- **Tracing/profiling**: the reference's only hook is a commented-out
-  ``NCCL_DEBUG=INFO`` env knob (multi-GPU-training-torch.py:8-10). tpuddp's
-  analog is env-toggled XLA profiling: ``TPUDDP_PROFILE=<dir>`` starts a
-  ``jax.profiler`` trace (viewable in TensorBoard/XProf, captures HLO +
-  TPU step events) for the first epoch.
-- **NaN detection**: ``TPUDDP_DEBUG_NANS=1`` makes the epoch driver raise on
-  non-finite aggregated losses (the "race detection / sanitizer" row of
-  SURVEY.md §5 — JAX's functional purity removes data races; numerical blowup
-  is the failure mode worth a guard). The epoch driver fires it BEFORE any
-  checkpoint save, so a poisoned epoch can never persist its state. The
-  in-step complement — skipping the poisoned update itself — is the
-  ``training.guard`` firewall (tpuddp/resilience/guard.py).
-- **Metrics**: per-epoch JSONL history written by process 0 next to the
-  checkpoints, replacing grep-able stdout as the machine-readable record
-  (condor .out parsing in the reference, submit_job.py:36-38).
-- **Comm-bytes accounting**: :class:`CommBytesCounter` turns the static
-  per-update gradient-communication payload (parallel/comm.py's accounting
-  model — the operand bytes entering the gradient collective, in its wire
-  dtype) into a running per-epoch/cumulative counter, so a compressed
-  comm hook's byte reduction is a recorded artifact in ``history.jsonl``
-  and the bench output, not a claim.
-"""
+from tpuddp.observability import (  # noqa: F401
+    CommBytesCounter,
+    MetricsWriter,
+    check_finite,
+    json_sanitize,
+    maybe_start_profiler,
+    nan_checks_enabled,
+    stop_profiler,
+)
 
-from __future__ import annotations
-
-import json
-import math
-import os
-from typing import Optional
-
-import jax
-
-_PROFILE_ENV = "TPUDDP_PROFILE"
-_NANS_ENV = "TPUDDP_DEBUG_NANS"
-_profiling = {"active": False}
-
-
-def maybe_start_profiler(default_dir: Optional[str] = None) -> bool:
-    """Start an XLA trace if $TPUDDP_PROFILE is set (its value is the trace
-    dir; '1' falls back to ``default_dir``/trace). Returns True if started."""
-    target = os.environ.get(_PROFILE_ENV)
-    if not target or _profiling["active"]:
-        return False
-    if target == "1":
-        if default_dir is None:
-            return False
-        target = os.path.join(default_dir, "trace")
-    os.makedirs(target, exist_ok=True)
-    jax.profiler.start_trace(target)
-    _profiling["active"] = True
-    return True
-
-
-def stop_profiler() -> None:
-    if _profiling["active"]:
-        jax.profiler.stop_trace()
-        _profiling["active"] = False
-
-
-def nan_checks_enabled() -> bool:
-    return os.environ.get(_NANS_ENV, "") not in ("", "0")
-
-
-def json_sanitize(value):
-    """Strict-JSON form of a record: non-finite floats become ``None``
-    (serialized ``null``), recursively through dicts/lists/tuples.
-
-    Python's ``json.dumps`` default emits bare ``NaN``/``Infinity`` tokens —
-    *invalid* JSON that strict parsers (jq, serde, JSON.parse, BigQuery
-    loads) reject, which made ``history.jsonl`` and ``bench_results.json``
-    unconsumable the moment an epoch blew up (the empty-test-loader path
-    writes ``float("nan")`` test metrics by design). Writers here pair this
-    with ``json.dumps(..., allow_nan=False)`` so any future non-finite leak
-    fails loudly at write time instead of corrupting the artifact."""
-    if isinstance(value, dict):
-        return {k: json_sanitize(v) for k, v in value.items()}
-    if isinstance(value, (list, tuple)):
-        return [json_sanitize(v) for v in value]
-    if isinstance(value, float) and not math.isfinite(value):
-        return None
-    return value
-
-
-def check_finite(value: float, what: str) -> None:
-    """Raise if a host-side aggregated metric went non-finite (only when
-    $TPUDDP_DEBUG_NANS is set)."""
-    if nan_checks_enabled() and not math.isfinite(value):
-        raise FloatingPointError(f"non-finite {what}: {value}")
-
-
-class CommBytesCounter:
-    """Running gradient-communication byte counter (per replica).
-
-    The per-update payload is static (compiled into the step program), so the
-    counter is host-side multiplication — free next to a device step. ``None``
-    bytes-per-update (a ddp object predating init_state, or an Accelerator
-    facade without the attribute) degrades to an inert counter whose
-    :meth:`snapshot` returns ``{}`` so epoch records stay unchanged.
-    """
-
-    def __init__(self, bytes_per_update):
-        self.bytes_per_update = (
-            int(bytes_per_update) if bytes_per_update else None
-        )
-        self.updates = 0
-
-    def add_updates(self, n: int) -> None:
-        self.updates += int(n)
-
-    @property
-    def total_bytes(self):
-        if self.bytes_per_update is None:
-            return None
-        return self.bytes_per_update * self.updates
-
-    def snapshot(self, epoch_updates: int = None) -> dict:
-        """Record fields for the JSONL history: the static per-update payload,
-        the cumulative total, and (when given) this epoch's slice."""
-        if self.bytes_per_update is None:
-            return {}
-        out = {
-            "grad_comm_bytes_per_update": self.bytes_per_update,
-            "grad_comm_bytes_total": self.total_bytes,
-        }
-        if epoch_updates is not None:
-            out["grad_comm_bytes_epoch"] = self.bytes_per_update * int(epoch_updates)
-        return out
-
-
-class MetricsWriter:
-    """Process-0 JSONL metrics sink (``history.jsonl`` in the run dir).
-
-    Holds one append handle (opened lazily at the first record) and flushes
-    after every line, so the file always ends on a whole JSON record — a crash
-    or preemption mid-epoch must not truncate the machine-readable history.
-    The epoch driver calls :meth:`close` from its ``finally`` block."""
-
-    def __init__(self, save_dir: Optional[str], filename: str = "history.jsonl"):
-        self.path = None
-        self._f = None
-        if save_dir is not None and jax.process_index() == 0:
-            os.makedirs(save_dir, exist_ok=True)
-            self.path = os.path.join(save_dir, filename)
-
-    def write(self, record: dict) -> None:
-        if self.path is None:
-            return
-        if self._f is None:
-            self._f = open(self.path, "a")
-        # strict JSON on disk: NaN/Inf metrics (a blown-up epoch's
-        # post-mortem row) serialize as null, never as the bare NaN token
-        # strict parsers reject
-        self._f.write(json.dumps(json_sanitize(record), allow_nan=False) + "\n")
-        self._f.flush()
-
-    def flush(self) -> None:
-        if self._f is not None:
-            self._f.flush()
-
-    def close(self) -> None:
-        if self._f is not None:
-            self._f.close()
-            self._f = None
-
-    def __del__(self):  # backstop for callers that never reach close()
-        try:
-            self.close()
-        except Exception:
-            pass
+__all__ = [
+    "CommBytesCounter",
+    "MetricsWriter",
+    "check_finite",
+    "json_sanitize",
+    "maybe_start_profiler",
+    "nan_checks_enabled",
+    "stop_profiler",
+]

@@ -58,21 +58,41 @@ def setup_dataloaders(training):
 
 
 def train(
-    model, train_loader, criterion, optimizer, accelerator, augment, deferred=False
+    model, train_loader, criterion, optimizer, accelerator, augment,
+    deferred=False, tel=None,
 ):
     """One training epoch. Returns ``(mean_batch_loss, samples_seen)`` —
-    the weighted sample count feeds the history.jsonl throughput fields."""
+    the weighted sample count feeds the history.jsonl throughput fields.
+    ``tel`` (observability.RunTelemetry) brackets each optimizer step with
+    its host-side timing/profiling hooks; under fuse_steps the laps measure
+    dispatch rate (the queue flushes every K steps), never forcing a flush."""
     model.train()
     running_loss = 0.0
     n_seen = 0.0
     batch_losses = []
+    # fuse_steps bookkeeping for the step recorder: an optimizer.step() that
+    # merely queues (fuse_steps=K enqueues K-1 of every K) is host-side
+    # microseconds, and crediting it as a step would report bookkeeping time
+    # as p50 while the Kth lap absorbs K steps of work. Steps accumulate here
+    # and are posted as ONE group when the queue has actually drained.
+    pend_steps, pend_samples = 0, 0
+
+    def post_if_flushed(force=False):
+        nonlocal pend_steps, pend_samples
+        if tel is None or pend_steps == 0:
+            return
+        if force or not getattr(optimizer, "_queue", None):
+            tel.post_dispatch(pend_steps, int(pend_samples))
+            pend_steps, pend_samples = 0, 0
+
     # ONE fresh key per epoch; the per-batch key is fold_in(base, i) INSIDE
     # the jitted augment — an eager split per batch would be a device
     # dispatch of its own (measured ~3 ms on tunneled runtimes)
     aug_base = accelerator.next_rng_key()
     for i, (inputs, labels, weights) in enumerate(train_loader):
         # no .to(device): placement is the backend's job (reference :44 note)
-        n_seen += float(np.sum(weights))
+        batch_n = float(np.sum(weights))
+        n_seen += batch_n
         optimizer.zero_grad()
 
         # Flip-augmented inputs (reference transform_train includes
@@ -80,6 +100,14 @@ def train(
         # accelerator's per-process PRNG stream.
         x = augment(aug_base, i, jnp.asarray(inputs))
 
+        if tel is not None:
+            # the step about to be enqueued is global_step + pend_steps, and
+            # the dispatch that will carry it is the WHOLE queued group — so
+            # the window profiler must see pend_steps + 1 upcoming steps, or
+            # a TPUDDP_PROFILE_STEPS window falling inside a not-yet-flushed
+            # fused group would arm one flush too late and trace the wrong
+            # steps
+            tel.pre_dispatch(pend_steps + 1)
         # model(...) and criterion(...) record lazily; accelerator.backward
         # runs them as ONE jitted value_and_grad over the sharded global batch,
         # and step() applies the stashed averaged grads.
@@ -87,6 +115,9 @@ def train(
         loss = criterion(outputs, labels, weights)
         accelerator.backward(loss)
         optimizer.step()
+        pend_steps += 1
+        pend_samples += batch_n
+        post_if_flushed()
 
         if deferred:
             # collect the LazyLoss objects; values materialize when the
@@ -107,6 +138,9 @@ def train(
         from tpuddp.accelerate import sum_losses
 
         running_loss = float(sum_losses(batch_losses))
+    # a ragged tail left in the fuse queue was flushed by sum_losses (or by
+    # flush_accumulation above): attribute its steps now, post-fence
+    post_if_flushed(force=True)
     return running_loss / len(train_loader), n_seen
 
 
@@ -169,22 +203,57 @@ def run_training_loop(
     checkpoint_epoch=5,
     deferred_metrics=False,
     start_epoch=0,
+    step_stats_every=0,
+    run_meta=None,
 ):
     # Observability parity with the native epoch driver (training/loop.py):
-    # $TPUDDP_PROFILE traces the first epoch, $TPUDDP_DEBUG_NANS guards the
-    # aggregated losses, and process 0 appends history.jsonl next to the
-    # checkpoints.
-    from tpuddp.resilience import guard as guard_lib
-    from tpuddp.utils.observability import (
+    # the typed run_meta header opens history.jsonl, epoch rows carry the
+    # step recorder's percentile/MFU fields, $TPUDDP_PROFILE traces the
+    # first epoch ($TPUDDP_PROFILE_STEPS a step window, SIGUSR1 the next
+    # epoch on demand), and $TPUDDP_DEBUG_NANS guards the aggregated losses.
+    from tpuddp.observability import (
         MetricsWriter,
+        RunTelemetry,
         check_finite,
+        make_run_meta,
         maybe_start_profiler,
+        stamp,
         stop_profiler,
     )
+    from tpuddp.resilience import guard as guard_lib
 
     metrics_writer = MetricsWriter(save_dir)
     profiling = maybe_start_profiler(save_dir)
     guard_cfg = guard_lib.resolve_guard(getattr(accelerator, "guard", None))
+    metrics_writer.write(make_run_meta(
+        mesh=getattr(accelerator, "mesh", None),
+        comm_hook=getattr(accelerator, "comm_hook", None),
+        guard=guard_cfg,
+        extra={
+            "api": "managed",
+            "fuse_steps": getattr(accelerator, "fuse_steps", None),
+            "grad_accumulation": getattr(
+                accelerator, "gradient_accumulation_steps", 1
+            ),
+            "start_epoch": start_epoch,
+            "num_epochs": num_epochs,
+            "step_stats_every": int(step_stats_every or 0),
+            **(run_meta or {}),
+        },
+    ))
+    # managed-path step timing is dispatch-resolution (a mid-epoch device
+    # fence would flush the fuse_steps queue and break the fusion it is
+    # measuring) — the epoch boundary's loss materialization is the fence
+    acc_mesh = getattr(accelerator, "mesh", None)
+    tel = RunTelemetry(
+        writer=metrics_writer,
+        save_dir=save_dir,
+        step_stats_every=step_stats_every,
+        world_size=int(acc_mesh.devices.size) if acc_mesh is not None else 1,
+        device_kind=(
+            acc_mesh.devices.flat[0].device_kind if acc_mesh is not None else None
+        ),
+    )
     prev_skips = optimizer.skip_counters()[0] if guard_cfg.enabled else 0
     rollback_count = {"n": 0}
 
@@ -206,12 +275,12 @@ def run_training_loop(
                 "known-good state — a systematic divergence, not a transient."
             )
         redo_epoch = accelerator.load_state(model, optimizer, save_dir)
-        metrics_writer.write({
+        metrics_writer.write(stamp("event", {
             "event": "rollback",
             "epoch": epoch,
             "resume_epoch": redo_epoch,
             "reason": reason,
-        })
+        }))
         if accelerator.is_local_main_process:
             print(
                 f"Guard rollback ({reason}): restored last-good state, "
@@ -233,6 +302,14 @@ def run_training_loop(
                     f"Preempted: emergency state for epoch "
                     f"{last_completed_epoch} saved."
                 )
+        # the drain's event row, fsync'd before the SIGKILL window closes
+        metrics_writer.write(stamp("event", {
+            "event": "preempt",
+            "epoch": last_completed_epoch + 1,
+            "completed": True,
+            "step": tel.recorder.global_step,
+        }))
+        metrics_writer.sync()
         raise TrainingPreempted(last_completed_epoch + 1)
 
     try:
@@ -252,9 +329,10 @@ def run_training_loop(
                 # with nothing to restore) exits 77 into auto-resume
                 bad_leaf = guard_lib.audit_params(accelerator.mesh, model._params)
                 if bad_leaf is not None:
-                    metrics_writer.write(
-                        {"event": "desync", "epoch": epoch, "leaf": bad_leaf}
-                    )
+                    metrics_writer.write(stamp(
+                        "event",
+                        {"event": "desync", "epoch": epoch, "leaf": bad_leaf},
+                    ))
                     if guard_cfg.on_desync == "rollback":
                         redo = rollback_to_last_good(
                             epoch, f"replica desync at leaf {bad_leaf}"
@@ -268,6 +346,7 @@ def run_training_loop(
                     )
             train_loader.set_epoch(epoch)
             epoch_t0 = time.perf_counter()
+            tel.start_epoch(epoch)
             train_loss, train_samples = train(
                 model,
                 train_loader,
@@ -276,7 +355,12 @@ def run_training_loop(
                 accelerator,
                 augment,
                 deferred=deferred_metrics,
+                tel=tel,
             )
+            # the train pass is done (deferred mode just materialized its
+            # losses — the fence); summarize before eval time can leak in,
+            # but keep any SIGUSR1 epoch trace running through evaluation
+            step_fields = tel.end_epoch(stop_trace=False)
             if preemption_requested():
                 # the train pass completed, so every update of this epoch is
                 # applied — save it as done and lose only the eval metrics
@@ -289,6 +373,9 @@ def run_training_loop(
                 eval_transform,
                 deferred=deferred_metrics,
             )
+            # the SIGUSR1 'next full epoch' capture includes eval (native
+            # parity — an operator tracing a slow eval must see it)
+            tel.stop_epoch_trace()
             epoch_time = time.perf_counter() - epoch_t0
 
             if profiling and epoch == start_epoch:
@@ -325,20 +412,26 @@ def run_training_loop(
             # the NaN guard so a blown-up epoch still leaves its post-mortem
             # row in history.jsonl (non-finite values land as strict-JSON
             # null, never a bare NaN token)
-            metrics_writer.write(
-                {
+            metrics_writer.write(stamp("epoch", {
+                "epoch": epoch,
+                "train_loss": train_loss,
+                "test_loss": test_loss,
+                "test_accuracy": test_accuracy,
+                "train_samples": train_samples,
+                "test_samples": test_samples,
+                "epoch_time_s": epoch_time,
+                "samples_per_sec": (train_samples + test_samples)
+                / max(epoch_time, 1e-9),
+                **step_fields,
+                **guard_fields,
+            }))
+            if guard_fields.get("skipped_steps_epoch"):
+                metrics_writer.write(stamp("event", {
+                    "event": "skipped_updates",
                     "epoch": epoch,
-                    "train_loss": train_loss,
-                    "test_loss": test_loss,
-                    "test_accuracy": test_accuracy,
-                    "train_samples": train_samples,
-                    "test_samples": test_samples,
-                    "epoch_time_s": epoch_time,
-                    "samples_per_sec": (train_samples + test_samples)
-                    / max(epoch_time, 1e-9),
-                    **guard_fields,
-                }
-            )
+                    "count": guard_fields["skipped_steps_epoch"],
+                    "total": guard_fields["skipped_steps"],
+                }))
             # $TPUDDP_DEBUG_NANS: both losses guarded BEFORE the checkpoint
             # below — a poisoned epoch must never persist its state
             check_finite(train_loss, "train loss")
@@ -374,10 +467,13 @@ def run_training_loop(
                 accelerator.save_state(model, optimizer, save_dir, epoch=epoch)
             epoch += 1
     finally:
+        # an exception mid-epoch must still flush any active trace (it is
+        # the post-mortem artifact) and never leave the JSONL history
+        # unflushed/truncated
+        tel.finish()
         if profiling:
-            # an exception mid-first-epoch must still flush the trace (it is
-            # the post-mortem artifact) and release the profiler latch
             stop_profiler()
+        metrics_writer.close()
 
     print("Finished Training.")
 
@@ -484,6 +580,8 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         if start_epoch and accelerator.is_local_main_process:
             print(f"Resumed from epoch {start_epoch - 1} state.")
 
+    from tpuddp.observability import config_hash
+
     run_training_loop(
         model,
         training_dataloader,
@@ -498,6 +596,13 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         checkpoint_epoch=training["checkpoint_epoch"],
         deferred_metrics=bool(training.get("deferred_metrics")),
         start_epoch=start_epoch,
+        step_stats_every=int(training.get("step_stats_every") or 0),
+        # run provenance for the history header: which configuration was this?
+        run_meta={
+            "config_hash": config_hash(training),
+            "model": training.get("model"),
+            "dataset": training.get("dataset"),
+        },
     )
 
 

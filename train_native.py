@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from tpuddp import config as cfg_lib
-from tpuddp import nn, optim, seeding
+from tpuddp import nn, observability as obs, optim, seeding
 from tpuddp.data import (
     PrefetchLoader,
     ShardedDataLoader,
@@ -174,6 +174,14 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         keep_last=(
             int(training["keep_last"]) if training.get("keep_last") else None
         ),
+        # telemetry (tpuddp.observability): per-window step_stats cadence +
+        # run provenance for the history.jsonl run_meta header
+        step_stats_every=int(training.get("step_stats_every") or 0),
+        run_meta={
+            "config_hash": obs.config_hash(training),
+            "model": training.get("model"),
+            "dataset": training.get("dataset"),
+        },
     )
 
 
