@@ -189,3 +189,26 @@ def test_training_config_refuses_unknown_keys():
     # every documented key still passes
     ok = cfg.training_config({"training": {"resume": True, "synthetic_n": [64, 32]}})
     assert ok["resume"] is True and ok["synthetic_n"] == [64, 32]
+
+
+def test_serving_config_defaults_and_merge():
+    out = cfg.serving_config({})
+    assert out == cfg.SERVING_DEFAULTS
+    out = cfg.serving_config(
+        {"serving": {"model": "alexnet", "num_replicas": 4,
+                     "per_tenant_quota": 8}}
+    )
+    assert out["model"] == "alexnet"
+    assert out["num_replicas"] == 4
+    assert out["per_tenant_quota"] == 8
+    # untouched knobs keep their defaults
+    assert out["max_batch_size"] == cfg.SERVING_DEFAULTS["max_batch_size"]
+
+
+def test_serving_config_refuses_unknown_keys():
+    """The serving block carries the same unknown-key-refusal contract as
+    training.guard: a typo'd knob fails loudly with a did-you-mean."""
+    with pytest.raises(ValueError, match="max_batch_szie.*did you mean.*max_batch_size"):
+        cfg.serving_config({"serving": {"max_batch_szie": 16}})
+    with pytest.raises(ValueError, match="unknown serving key"):
+        cfg.serving_config({"serving": {"zzz_not_a_knob": 1}})

@@ -1,0 +1,378 @@
+#!/usr/bin/env python
+"""loadgen — closed/open-loop load generator for the tpuddp serving engine.
+
+Produces the latency-vs-offered-throughput curve that makes a serving stack
+measurable: a closed-loop phase finds the engine's sustainable peak, then
+open-loop phases replay fixed offered rates (fractions of that peak) and
+record what clients would actually experience — end-to-end p50/p95/p99,
+achieved throughput, batch occupancy, rejects. ``vs_baseline`` anchors
+against sequential per-request serving (one request in flight, no
+coalescing — the no-continuous-batching strawman, measured through the same
+engine so queue costs land on both sides of the ratio); the raw batch=1
+direct-dispatch rate is reported alongside as the device ceiling.
+
+Artifacts:
+
+- ``--out``         — the curve in the ``bench_results.json`` payload format
+  (validated by ``tools/tpuddp_inspect.py --validate``; each offered-load
+  point is one row under ``configs``);
+- ``--history-dir`` — the engine's own ``history.jsonl`` (run_meta +
+  serving_stats windows + drain event), same validation;
+- stdout            — progress on stderr-like log lines, and the LAST line
+  is one compact JSON summary (bench.py's driver-parseable contract).
+
+Runs entirely in-process on the local mesh (CPU-friendly: the gate's serving
+leg drives ~100 requests against 2 replicas over 2 tenants); the same flags
+scale the sweep up on real chips.
+
+Usage:
+    python tools/loadgen.py --quick --history-dir /tmp/serve \\
+        --out /tmp/serve/bench_results.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def log(msg: str) -> None:
+    print(f"[loadgen] {msg}", flush=True)
+
+
+def _make_requests(rng, n, rows_max, sample_shape):
+    """Pre-generate request payloads so generation cost never pollutes the
+    timed phases."""
+    return [
+        rng.randn(int(rng.randint(1, rows_max + 1)), *sample_shape).astype(
+            np.float32
+        )
+        for _ in range(n)
+    ]
+
+
+def _pct(values, keys=(50, 95, 99)):
+    from tpuddp.observability import percentiles
+
+    return {
+        k: (None if v is None else round(v, 3))
+        for k, v in percentiles(values, keys).items()
+    }
+
+
+def closed_loop(engine, payloads, tenants, workers):
+    """Every worker keeps exactly one request in flight (submit -> wait ->
+    repeat): the classic saturation probe. Returns (e2e_ms list, wall_s)."""
+    from tpuddp.serving import AdmissionError
+
+    lock = threading.Lock()
+    cursor = {"i": 0}
+    e2e_ms = []
+
+    def run(worker_idx):
+        while True:
+            with lock:
+                i = cursor["i"]
+                if i >= len(payloads):
+                    return
+                cursor["i"] = i + 1
+            t0 = time.perf_counter()
+            try:
+                res = engine.submit(f"tenant{i % tenants}", payloads[i])
+            except AdmissionError:
+                continue  # counted by engine stats; keep probing
+            res.result(timeout=120)
+            with lock:
+                e2e_ms.append((res.done_at - t0) * 1e3)
+
+    threads = [
+        threading.Thread(target=run, args=(w,), daemon=True)
+        for w in range(workers)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return e2e_ms, time.perf_counter() - t0
+
+
+def open_loop(engine, payloads, tenants, offered_rps):
+    """Fixed-rate arrivals regardless of completions (the honest overload
+    probe: a closed loop self-throttles, an open loop does not). Returns
+    (e2e_ms of completed, rejected count, wall_s)."""
+    from tpuddp.serving import AdmissionError
+
+    interval = 1.0 / offered_rps
+    inflight = []
+    rejected = 0
+    t_start = time.perf_counter()
+    for i, x in enumerate(payloads):
+        target = t_start + i * interval
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t_submit = time.perf_counter()
+        try:
+            inflight.append((t_submit, engine.submit(f"tenant{i % tenants}", x)))
+        except AdmissionError:
+            rejected += 1
+    e2e_ms = []
+    for t_submit, res in inflight:
+        res.result(timeout=120)
+        e2e_ms.append((res.done_at - t_submit) * 1e3)
+    return e2e_ms, rejected, time.perf_counter() - t_start
+
+
+def raw_dispatch_rate(engine, payloads_1row, steps):
+    """Raw device ceiling: one replica, batch=1, direct ``infer`` calls with
+    no queue/thread machinery at all — the context figure that separates
+    engine overhead from device time in the report."""
+    replica = engine.pool.replicas[0]
+    t0 = time.perf_counter()
+    for x in payloads_1row[:steps]:
+        np.asarray(replica.infer(x))
+    dt = time.perf_counter() - t0
+    return steps / dt
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    parser.add_argument("--settings", default=None,
+                        help="YAML settings file (serving block)")
+    parser.add_argument("--model", default=None, help="override serving.model")
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--tenants", type=int, default=2)
+    parser.add_argument("--max-batch", type=int, default=None)
+    parser.add_argument("--requests", type=int, default=300,
+                        help="requests per load point")
+    parser.add_argument("--rows-max", type=int, default=4,
+                        help="rows per request drawn uniform from [1, rows_max]")
+    parser.add_argument("--loads", default="0.5,0.75,1.0",
+                        help="open-loop offered rates as fractions of the "
+                        "closed-loop peak (comma separated)")
+    parser.add_argument("--workers", type=int, default=None,
+                        help="closed-loop concurrency (default 4 x replicas)")
+    parser.add_argument("--history-dir", default=None,
+                        help="engine history.jsonl destination")
+    parser.add_argument("--out", default=None,
+                        help="bench-format curve destination "
+                        "(default: <history-dir>/bench_results.json)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--quick", action="store_true",
+                        help="CI sizing: ~100 requests total, tiny model")
+    args = parser.parse_args(argv)
+
+    from tpuddp import config as config_lib
+    from tpuddp.observability import json_sanitize
+    from tpuddp.serving import ServingEngine
+
+    settings = (
+        config_lib.load_settings(args.settings) if args.settings else {}
+    )
+    cfg = config_lib.serving_config(settings)
+    if args.model:
+        cfg["model"] = args.model
+    if args.replicas:
+        cfg["num_replicas"] = args.replicas
+    if args.max_batch:
+        cfg["max_batch_size"] = args.max_batch
+    n_per_load = args.requests
+    if args.quick:
+        n_per_load = 34  # 3 open points -> ~100 requests + calibration
+        cfg["max_batch_size"] = min(int(cfg["max_batch_size"]), 8)
+        cfg["stats_window"] = 16
+
+    engine = ServingEngine.from_config(cfg, out_dir=args.history_dir)
+    log(
+        f"engine: model={cfg['model']} replicas={len(engine.pool)} "
+        f"max_batch={engine.scheduler.max_batch_size} "
+        f"buckets={engine.scheduler.buckets} tenants={args.tenants}"
+    )
+    engine.start()  # warms every bucket program on every replica
+
+    rng = np.random.RandomState(args.seed)
+    shape = engine.pool.sample_shape
+    rows_max = max(1, min(args.rows_max, engine.scheduler.max_batch_size))
+    configs = {}
+
+    # -- correctness proof before any timing: served logits must be bitwise
+    # a direct model forward over the same padded batch (params passed as
+    # arguments, exactly like the replica's own program)
+    import jax
+
+    from tpuddp.nn.core import Context
+    from tpuddp.utils import batching
+
+    module = engine.pool.module
+    r0 = engine.pool.replicas[0]
+
+    @jax.jit
+    def _direct(p, s, x):
+        ctx = Context(train=False, rng=jax.random.key(0), axis_name=None)
+        return module.apply(p, s, x, ctx)[0]
+
+    for rows in sorted({1, rows_max, engine.scheduler.max_batch_size}):
+        x = rng.randn(rows, *shape).astype(np.float32)
+        served = engine.submit("verify", x).result(timeout=120)
+        xp, _, _ = batching.pad_batch(
+            x, None, batching.bucket_for(rows, engine.scheduler.max_batch_size)
+        )
+        ref = np.asarray(_direct(r0.params, r0.model_state, xp))[:rows]
+        if not np.array_equal(served, ref):
+            log(f"FATAL: served logits diverge from direct forward at "
+                f"rows={rows}")
+            return 1
+    log("verified: served logits bitwise-equal direct forward "
+        f"(rows in {sorted({1, rows_max, engine.scheduler.max_batch_size})})")
+
+    # -- baseline: sequential per-request serving (the strawman a server
+    # WITHOUT continuous batching is: one request in flight, no coalescing,
+    # every request its own dispatch) — through the engine, so queue/thread
+    # costs land on both sides of the ratio honestly
+    ones = _make_requests(rng, 64, 1, shape)
+    baseline_steps = 32 if args.quick else 128
+    raw_dispatch_rate(engine, ones, 8)  # warm the (1,...) program path
+    raw_rps = raw_dispatch_rate(engine, ones, min(baseline_steps, len(ones)))
+    base_n = min(n_per_load, 64) if args.quick else n_per_load
+    base_payloads = _make_requests(rng, base_n, rows_max, shape)
+    # a per-request server would not linger hoping to coalesce — zero the
+    # batch timeout for the baseline phase so the ratio measures continuous
+    # batching, not the engine's own linger penalty charged to the strawman
+    linger = engine.scheduler.batch_timeout_s
+    engine.scheduler.batch_timeout_s = 0.0
+    try:
+        base_e2e, base_wall = closed_loop(engine, base_payloads, args.tenants, 1)
+    finally:
+        engine.scheduler.batch_timeout_s = linger
+    base_rps = len(base_e2e) / max(base_wall, 1e-9)
+    log(
+        f"baseline (sequential per-request serving): {base_rps:,.1f} req/s "
+        f"(raw single-dispatch ceiling {raw_rps:,.0f}/s)"
+    )
+
+    # -- closed loop: find the sustainable peak -----------------------------
+    workers = args.workers or 4 * len(engine.pool)
+    payloads = _make_requests(rng, n_per_load, rows_max, shape)
+    m = engine.stats.mark()
+    e2e, wall = closed_loop(engine, payloads, args.tenants, workers)
+    d = engine.stats.since(m)
+    peak_rps = len(e2e) / wall if wall else 0.0
+    configs["closed_loop"] = {
+        "mode": "closed",
+        "workers": workers,
+        "offered_rps": None,
+        "achieved_rps": round(peak_rps, 2),
+        "requests": len(payloads),
+        "completed": len(e2e),
+        "rejected": d["rejected"],
+        **{f"e2e_ms_{k}": v for k, v in _pct(e2e).items()
+           if k in ("p50", "p95", "p99")},
+        "queue_ms_p50": d["queue_ms"]["p50"],
+        "batch_occupancy": d["batch_occupancy"],
+        "samples_per_sec_per_chip": round(
+            d["rows"] / max(wall, 1e-9) / len(engine.pool), 2
+        ),
+        "ms_per_step": d["device_ms"]["p50"],
+    }
+    log(
+        f"closed loop ({workers} workers): {peak_rps:,.1f} req/s, "
+        f"p99 {configs['closed_loop']['e2e_ms_p99']} ms, "
+        f"occupancy {d['batch_occupancy']}"
+    )
+
+    # -- open loop: the latency-vs-offered-throughput curve -----------------
+    fractions = [float(f) for f in args.loads.split(",") if f.strip()]
+    for frac in fractions:
+        offered = max(1.0, peak_rps * frac)
+        payloads = _make_requests(rng, n_per_load, rows_max, shape)
+        m = engine.stats.mark()
+        e2e, rejected, wall = open_loop(engine, payloads, args.tenants, offered)
+        d = engine.stats.since(m)
+        name = f"open_{frac:g}x"
+        configs[name] = {
+            "mode": "open",
+            "offered_fraction_of_peak": frac,
+            "offered_rps": round(offered, 2),
+            "achieved_rps": round(len(e2e) / max(wall, 1e-9), 2),
+            "requests": len(payloads),
+            "completed": len(e2e),
+            "rejected": rejected,
+            **{f"e2e_ms_{k}": v for k, v in _pct(e2e).items()
+               if k in ("p50", "p95", "p99")},
+            "queue_ms_p50": d["queue_ms"]["p50"],
+            "batch_occupancy": d["batch_occupancy"],
+            "samples_per_sec_per_chip": round(
+                d["rows"] / max(wall, 1e-9) / len(engine.pool), 2
+            ),
+            "ms_per_step": d["device_ms"]["p50"],
+        }
+        log(
+            f"open loop {frac:g}x ({offered:,.1f} req/s offered): "
+            f"achieved {configs[name]['achieved_rps']:,.1f} req/s, "
+            f"p50 {configs[name]['e2e_ms_p50']} ms, "
+            f"p99 {configs[name]['e2e_ms_p99']} ms, rejected {rejected}"
+        )
+
+    summary = engine.drain(reason="loadgen_complete")
+
+    # -- bench-format artifact ----------------------------------------------
+    import jax
+
+    device_kind = jax.devices()[0].device_kind
+    vs = peak_rps / base_rps if base_rps else 1.0
+    payload = {
+        "metric": f"serving_{cfg['model']}_peak_requests_per_sec",
+        "value": round(peak_rps, 1),
+        "unit": "requests/sec",
+        "vs_baseline": round(vs, 2),
+        "vs_baseline_basis": "sequential per-request serving (1 in flight)",
+        "baseline_rps": round(base_rps, 2),
+        "raw_single_dispatch_rps": round(raw_rps, 2),
+        "device": device_kind,
+        "tenants": args.tenants,
+        "replicas": len(engine.pool),
+        "max_batch_size": engine.scheduler.max_batch_size,
+        "rows_max": rows_max,
+        "configs": configs,
+    }
+    out_path = args.out or (
+        os.path.join(args.history_dir, "bench_results.json")
+        if args.history_dir
+        else os.path.join(_REPO, "bench_results.json")
+    )
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(json_sanitize(payload), f, indent=2, allow_nan=False)
+        f.write("\n")
+    log(f"curve -> {out_path}")
+    if args.history_dir:
+        log(f"history -> {os.path.join(args.history_dir, 'history.jsonl')}")
+
+    # last stdout line: compact driver-parseable summary (bench.py contract)
+    print(json.dumps(json_sanitize({
+        "metric": payload["metric"],
+        "value": payload["value"],
+        "unit": payload["unit"],
+        "vs_baseline": payload["vs_baseline"],
+        "device": device_kind,
+        "n_configs": len(configs),
+        "completed": summary["completed"],
+        "rejected": sum(summary["rejected"].values()),
+        "results_file": os.path.basename(out_path),
+    }), allow_nan=False))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

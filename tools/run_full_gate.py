@@ -23,6 +23,14 @@ if a ``bench_results.json`` exists at the repo root, it is validated too. A
 writer drifting off the typed record schema (tpuddp/observability/schema.py)
 fails the gate here instead of corrupting downstream consumers.
 
+Serving gate (last): ``tools/loadgen.py --quick`` stands the continuous-
+batching engine up on the CPU mesh (2 replicas, 2 tenants, ~170 requests
+across a closed-loop calibration + 3 offered-load points) and both emitted
+artifacts — the engine's ``history.jsonl`` (run_meta + serving_stats +
+events) and the latency-vs-throughput ``bench_results.json`` curve — must
+pass ``tpuddp_inspect --validate``. The serving SLO record stream drifting
+off schema v2 fails the gate the same way training telemetry drift does.
+
 Usage: python tools/run_full_gate.py [extra pytest args]
 
 The two-tier contract is documented in README "Testing"; the chaos tier can
@@ -86,6 +94,43 @@ def _schema_gate(env) -> int:
     return 0
 
 
+def _serving_gate(env) -> int:
+    """Drive the serving engine with loadgen, then validate its artifacts."""
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    with tempfile.TemporaryDirectory(prefix="tpuddp_serve_gate_") as out_dir:
+        worker_env = dict(env)
+        worker_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        bench_json = os.path.join(out_dir, "bench_results.json")
+        rc = subprocess.call(
+            [
+                sys.executable, "-u",
+                os.path.join(REPO, "tools", "loadgen.py"),
+                "--quick", "--replicas", "2", "--tenants", "2",
+                "--history-dir", out_dir, "--out", bench_json,
+            ],
+            cwd=REPO, env=worker_env,
+        )
+        if rc != 0:
+            print(f"serving gate: loadgen exited {rc}", file=sys.stderr)
+            return rc
+        for artifact in (os.path.join(out_dir, "history.jsonl"), bench_json):
+            rc = subprocess.call(
+                [sys.executable, inspect, "--validate", artifact],
+                cwd=REPO, env=env,
+            )
+            if rc != 0:
+                print(
+                    f"serving gate: {os.path.basename(artifact)} failed "
+                    "validation", file=sys.stderr,
+                )
+                return rc
+    return 0
+
+
 def main(argv=None):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")  # the full gate never needs a real TPU
@@ -98,7 +143,10 @@ def main(argv=None):
     rc = subprocess.call(cmd, cwd=REPO, env=env)
     if rc != 0:
         return rc
-    return _schema_gate(env)
+    rc = _schema_gate(env)
+    if rc != 0:
+        return rc
+    return _serving_gate(env)
 
 
 if __name__ == "__main__":

@@ -101,6 +101,7 @@ def summarize_history(path: str) -> None:
     events = [r for r in records if r.get("type") == "event" or (
         "type" not in r and "event" in r)]
     steps = [r for r in records if r.get("type") == "step_stats"]
+    serving = [r for r in records if r.get("type") == "serving_stats"]
 
     if metas:
         m = metas[-1]
@@ -110,6 +111,10 @@ def summarize_history(path: str) -> None:
             "world_size", "process_count", "device_kind", "jax_version",
             "tpuddp_version", "comm_hook", "scan_steps", "grad_accumulation",
             "step_stats_every",
+            # serving run_meta fields (api == "serving")
+            "num_replicas", "max_batch_size", "max_queue_depth",
+            "per_tenant_quota", "batch_timeout_ms", "buckets", "input_shape",
+            "restored_epoch", "checkpoint_dir",
         ):
             if m.get(k) is not None:
                 print(f"  {k:>20}: {m[k]}")
@@ -144,6 +149,33 @@ def summarize_history(path: str) -> None:
             print(f"\nstep_stats windows: {len(steps)} "
                   f"(finest p99 {max(s.get('step_time_ms_p99') or 0 for s in steps):.2f} ms, "
                   f"window size {steps[0].get('steps')})")
+
+    if serving:
+        print(f"\nserving_stats windows ({len(serving)}):")
+        rows = []
+        for s in serving:
+            rows.append([
+                str(s.get("window")),
+                str(s.get("requests")),
+                str(s.get("completed")),
+                str(s.get("rejected")),
+                _fmt(s.get("queue_ms_p50"), 2),
+                _fmt(s.get("device_ms_p50"), 2),
+                _fmt(s.get("e2e_ms_p50"), 2),
+                _fmt(s.get("e2e_ms_p95"), 2),
+                _fmt(s.get("e2e_ms_p99"), 2),
+                _fmt(s.get("throughput_rps"), 0),
+                _fmt(s.get("batch_occupancy"), 3),
+            ])
+        _print_table(rows, [
+            "win", "req", "done", "rej", "q50ms", "d50ms",
+            "e2e50", "e2e95", "e2e99", "rps", "occ",
+        ])
+        done = sum(s.get("completed") or 0 for s in serving)
+        rej = sum(s.get("rejected") or 0 for s in serving)
+        worst = max((s.get("e2e_ms_p99") or 0) for s in serving)
+        print(f"  totals: {done} completed, {rej} rejected, "
+              f"worst-window e2e p99 {worst:.2f} ms")
 
     # gradient-comm byte savings: compressed vs the f32 baseline the header
     # records; totals from the newest epoch's cumulative counter
@@ -180,8 +212,29 @@ def summarize_bench(path: str) -> None:
           f"{payload.get('unit')} on {payload.get('device')} "
           f"(vs_baseline {payload.get('vs_baseline')} over "
           f"{payload.get('vs_baseline_basis')})")
+    configs = payload.get("configs", {})
+    if any(isinstance(r, dict) and "offered_rps" in r for r in configs.values()):
+        # serving curve rows (tools/loadgen.py): offered-vs-achieved
+        # throughput with client-side latency percentiles
+        rows = []
+        for name, r in configs.items():
+            rows.append([
+                name,
+                _fmt(r.get("offered_rps"), 0),
+                _fmt(r.get("achieved_rps"), 0),
+                _fmt(r.get("e2e_ms_p50"), 2),
+                _fmt(r.get("e2e_ms_p99"), 2),
+                _fmt(r.get("batch_occupancy"), 3),
+                str(r.get("rejected", "-")),
+                _fmt(r.get("samples_per_sec_per_chip"), 0),
+            ])
+        _print_table(rows, [
+            "config", "offered", "rps", "e2e50ms", "e2e99ms", "occ",
+            "rej", "rows/chip",
+        ])
+        return
     rows = []
-    for name, r in payload.get("configs", {}).items():
+    for name, r in configs.items():
         rows.append([
             name,
             _fmt(r.get("samples_per_sec_per_chip"), 0),

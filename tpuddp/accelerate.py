@@ -46,6 +46,7 @@ from tpuddp.parallel import comm as comm_lib
 from tpuddp.parallel.mesh import data_mesh, replicate, shard_batch
 from tpuddp.resilience import guard as guard_lib
 from tpuddp.training import checkpoint as ckpt
+from tpuddp.utils import batching
 
 
 class LazyForward:
@@ -282,9 +283,9 @@ class FusedEvaluator:
     def add(self, x, y, w=None):
         if w is None:
             w = np.ones(len(y), np.float32)
-        # no jnp/np conversion here: x may be a staged device array and
-        # np.asarray on it would force a host transfer
-        shape_key = (tuple(np.shape(x)), str(getattr(x, "dtype", "untyped")))
+        # metadata-only key (shared with serving's scheduler): x may be a
+        # staged device array and np.asarray on it would force a transfer
+        shape_key = batching.shape_key(x)
         if self._queue and self._queue[0][0] != shape_key:
             self._flush()  # ragged stream: never stack mixed shapes
         self._queue.append((shape_key, x, y, w))
@@ -460,14 +461,11 @@ def _resolve_auto_fuse(params, batch_nbytes=None) -> int:
     tunnel's per-dispatch RTT swings up to ~240 ms between sessions — depth
     is the amortization lever (BASELINE.md "Dispatch-RTT variance").
     ``params`` stays in the signature as the size hook should the policy
-    become size-keyed again."""
+    become size-keyed again. The budget-cap arithmetic is the shared
+    implementation in ``tpuddp/utils/batching.py`` (one policy for eval
+    fusion, managed train fusion, and serving's device queues)."""
     del params
-    cap = 32
-    if batch_nbytes:
-        from tpuddp.training.loop import _STAGE_BYTES_BUDGET
-
-        cap = max(1, min(cap, _STAGE_BYTES_BUDGET // int(batch_nbytes)))
-    return cap
+    return batching.resolve_fuse(batch_nbytes, cap=32)
 
 
 class _LostState:

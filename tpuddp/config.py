@@ -88,6 +88,66 @@ TRAINING_DEFAULTS = {
     # rows; epoch rows always carry the full-epoch percentiles either way.
 }
 
+# Serving-engine knobs (tpuddp/serving/) — the ``serving`` block of a
+# settings file, consumed by ``python -m tpuddp.serving`` and tools/loadgen.py.
+# Same unknown-key-refusal contract as the ``training`` block.
+SERVING_DEFAULTS = {
+    "model": "toy_mlp",  # model-zoo name (tpuddp/models)
+    "num_classes": 10,
+    "input_shape": [32, 32, 3],  # one sample's x shape (no batch axis) — the
+    # shape requests carry and the checkpoint template is initialized from
+    "checkpoint_dir": None,  # restore the newest INTACT checkpoint from here
+    # via training/checkpoint.restore_latest (sha256-verified, corrupt files
+    # skipped); None -> fresh seeded init (CI / loadgen worlds)
+    "checkpoint_prefix": "auto",  # which checkpoint family to restore:
+    # "ckpt" (native TrainState files), "state" (managed full-state files),
+    # or "auto" -> whichever family has the newest intact file
+    "num_replicas": "auto",  # independent model replicas, one per local
+    # device; "auto" -> every local device
+    "max_batch_size": 32,  # coalescing ceiling: requests stack into
+    # power-of-two row buckets up to this (compile cache holds at most
+    # log2(max)+1 programs per sample shape)
+    "max_queue_depth": 256,  # admission control: total queued requests
+    # beyond this are rejected with reason "queue_full"
+    "per_tenant_quota": None,  # max queued requests per tenant (None -> no
+    # per-tenant bound); excess rejected with reason "tenant_quota"
+    "batch_timeout_ms": 2.0,  # how long a dispatch loop waits for more rows
+    # after the first request is in hand (latency/occupancy tradeoff)
+    "stats_window": 64,  # completed requests per serving_stats history row
+    "seed": 0,  # fresh-init parameter seed (ignored with a checkpoint)
+}
+
+
+def _merge_refusing_unknown(defaults, overrides, block: str):
+    """Defaults + overrides, refusing unknown keys with a did-you-mean hint —
+    a typo'd knob silently ignored would run a different configuration than
+    the file says. Shared by the ``training`` and ``serving`` blocks."""
+    unknown = set(overrides) - set(defaults)
+    if unknown:
+        import difflib
+
+        hints = []
+        for k in sorted(unknown):
+            close = difflib.get_close_matches(k, defaults, n=1)
+            hints.append(f"{k!r}" + (f" (did you mean {close[0]!r}?)" if close else ""))
+        raise ValueError(
+            f"unknown {block} key(s): {', '.join(hints)}. Known keys: "
+            f"{sorted(defaults)}"
+        )
+    cfg = dict(defaults)
+    cfg.update(overrides)
+    return cfg
+
+
+def serving_config(settings: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge the settings file's ``serving`` block over
+    :data:`SERVING_DEFAULTS`, refusing unknown keys (the ``training.guard``
+    contract)."""
+    return _merge_refusing_unknown(
+        SERVING_DEFAULTS, settings.get("serving") or {}, "serving"
+    )
+
+
 # Label-space size by dataset name; the reference hardcodes 10 because its only
 # dataset is CIFAR-10 (data_and_toy_model.py:44's Linear(4096, 10)).
 DATASET_NUM_CLASSES = {
@@ -220,19 +280,6 @@ def training_config(settings: Dict[str, Any]) -> Dict[str, Any]:
     Unknown keys are REFUSED with a did-you-mean hint — a typo'd knob
     (``wieght_update_sharding``) silently ignored would train a different
     configuration than the file says."""
-    cfg = dict(TRAINING_DEFAULTS)
-    overrides = settings.get("training") or {}
-    unknown = set(overrides) - set(TRAINING_DEFAULTS)
-    if unknown:
-        import difflib
-
-        hints = []
-        for k in sorted(unknown):
-            close = difflib.get_close_matches(k, TRAINING_DEFAULTS, n=1)
-            hints.append(f"{k!r}" + (f" (did you mean {close[0]!r}?)" if close else ""))
-        raise ValueError(
-            f"unknown training key(s): {', '.join(hints)}. Known keys: "
-            f"{sorted(TRAINING_DEFAULTS)}"
-        )
-    cfg.update(overrides)
-    return cfg
+    return _merge_refusing_unknown(
+        TRAINING_DEFAULTS, settings.get("training") or {}, "training"
+    )

@@ -33,6 +33,7 @@ import jax
 import numpy as np
 
 from tpuddp.parallel.sampler import DistributedSampler
+from tpuddp.utils import batching
 
 try:
     from tpuddp.data import _native
@@ -52,15 +53,10 @@ def _fetch(dataset, indices: np.ndarray):
 
 
 def _pad_batch(x: np.ndarray, y: np.ndarray, batch_size: int):
-    """Pad to the static batch size; w marks real samples."""
-    n = len(y)
-    w = np.ones(batch_size, np.float32)
-    if n < batch_size:
-        pad = batch_size - n
-        x = np.concatenate([x, np.repeat(x[:1], pad, axis=0)])
-        y = np.concatenate([y, np.zeros(pad, y.dtype)])
-        w[n:] = 0.0
-    return x, y, w
+    """Pad to the static batch size; w marks real samples. The one padding
+    implementation is shared with eval fusion and serving
+    (tpuddp/utils/batching.py)."""
+    return batching.pad_batch(x, y, batch_size)
 
 
 def _fetch_padded(dataset, indices: np.ndarray, batch_size: int):

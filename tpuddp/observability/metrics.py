@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import threading
 from typing import Optional
 
 import jax
@@ -121,7 +122,13 @@ class MetricsWriter:
     single-writer history contract. ``main_only=False`` lets any process
     append (used by the watchdog, whose stale-peer event fires on whichever
     process detected it); single-line appends below PIPE_BUF are atomic on
-    POSIX, so concurrent writers interleave whole records, never bytes."""
+    POSIX, so concurrent writers interleave whole records, never bytes.
+
+    Writes are additionally serialized by an intra-process lock: the serving
+    engine's dispatch threads share ONE writer (serving_stats windows,
+    dispatch-error events, the drain event), and ``TextIOWrapper`` gives no
+    cross-thread atomicity guarantee of its own — an unserialized interleave
+    would corrupt a line and fail the schema gate."""
 
     def __init__(
         self,
@@ -131,6 +138,7 @@ class MetricsWriter:
     ):
         self.path = None
         self._f = None
+        self._lock = threading.Lock()
         if save_dir is not None and (not main_only or jax.process_index() == 0):
             os.makedirs(save_dir, exist_ok=True)
             self.path = os.path.join(save_dir, filename)
@@ -138,23 +146,32 @@ class MetricsWriter:
     def write(self, record: dict) -> None:
         if self.path is None:
             return
-        if self._f is None:
-            # line-buffered: every completed line reaches the OS immediately,
-            # without a per-write flush syscall pair
-            self._f = open(self.path, "a", buffering=1)
-        # strict JSON on disk: NaN/Inf metrics (a blown-up epoch's
-        # post-mortem row) serialize as null, never the bare NaN token
-        # strict parsers reject
-        self._f.write(json.dumps(json_sanitize(record), allow_nan=False) + "\n")
+        # serialize the record OUTSIDE the lock (the expensive part), append
+        # the whole line inside it
+        line = json.dumps(json_sanitize(record), allow_nan=False) + "\n"
+        with self._lock:
+            if self._f is None:
+                # line-buffered: every completed line reaches the OS
+                # immediately, without a per-write flush syscall pair
+                self._f = open(self.path, "a", buffering=1)
+            # strict JSON on disk: NaN/Inf metrics (a blown-up epoch's
+            # post-mortem row) serialize as null, never the bare NaN token
+            # strict parsers reject
+            self._f.write(line)
 
     def flush(self) -> None:
-        if self._f is not None:
-            self._f.flush()
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
 
     def sync(self) -> None:
         """Flush + fsync: force written records to disk *now*. Called on the
         preemption-drain path (and by :meth:`close`) so the final event row
         survives the SIGKILL that follows the grace window."""
+        with self._lock:
+            self._sync_locked()
+
+    def _sync_locked(self) -> None:
         if self._f is not None:
             self._f.flush()
             try:
@@ -163,10 +180,11 @@ class MetricsWriter:
                 pass  # fsync is best-effort on exotic filesystems
 
     def close(self) -> None:
-        if self._f is not None:
-            self.sync()
-            self._f.close()
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                self._sync_locked()
+                self._f.close()
+                self._f = None
 
     def __del__(self):  # backstop for callers that never reach close()
         try:

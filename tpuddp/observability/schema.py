@@ -12,11 +12,19 @@ Every line of ``history.jsonl`` is one JSON object carrying ``type`` (one of
   steps) inside an epoch: the intra-epoch resolution that makes a 10x
   step-time regression or a straggler *within* an epoch visible.
 - ``event``    — discrete occurrences: rollback, desync, preempt, skipped
-  updates, watchdog staleness, profiler captures.
+  updates, watchdog staleness, profiler captures, serving drain.
+- ``serving_stats`` — one row per serving-engine reporting window
+  (tpuddp/serving/stats.py): request/completion/reject counts, queue /
+  device / end-to-end latency percentiles, throughput, and batch occupancy
+  — the SLO record stream of the inference engine.
 
 ``tools/tpuddp_inspect.py --validate`` enforces this schema, so drift fails
 a gate instead of corrupting downstream consumers. The validators live here
 (not in the tool) so writer tests and the CLI share one definition.
+
+Version history: v1 introduced the envelope and the four training record
+types; v2 added ``serving_stats``. Readers accept every version up to their
+own ``SCHEMA_VERSION`` and reject newer files.
 """
 
 from __future__ import annotations
@@ -26,9 +34,9 @@ import hashlib
 import json
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
-RECORD_TYPES = ("run_meta", "epoch", "step_stats", "event")
+RECORD_TYPES = ("run_meta", "epoch", "step_stats", "event", "serving_stats")
 
 # Required keys per record type (beyond the envelope's type/schema_version).
 # Values may be null where a metric can legitimately blow up (strict-JSON
@@ -70,6 +78,19 @@ _REQUIRED = {
         "samples_per_sec",
     ),
     "event": ("event",),
+    "serving_stats": (
+        "window",
+        "requests",
+        "completed",
+        "rejected",
+        "queue_ms_p50",
+        "device_ms_p50",
+        "e2e_ms_p50",
+        "e2e_ms_p95",
+        "e2e_ms_p99",
+        "throughput_rps",
+        "batch_occupancy",
+    ),
 }
 
 

@@ -1,0 +1,252 @@
+"""ServingEngine — queue + scheduler + replica pool + SLO stats, assembled.
+
+One dispatch loop thread per replica pulls coalesced batches off the shared
+scheduler and runs them on its own device; N replicas therefore serve N
+batches genuinely concurrently (distinct devices, distinct programs) while
+admission, fairness, and bucketing stay centralized. ``submit`` is the whole
+client API: synchronous admission verdict (raises :class:`AdmissionError`
+with a machine-readable reason), asynchronous result future.
+
+Lifecycle: ``start()`` writes the ``run_meta`` header and compiles every
+bucket program on every replica (warmup — the first real request never pays
+a compile), ``drain()`` closes admission, lets the queued work finish,
+flushes the final stats window, and stamps a ``serving_drain`` event. The
+``__main__`` entrypoint maps SIGTERM onto drain + exit 75 — the resilience
+exit-code contract (tpuddp/resilience/preemption.py), so schedulers treat a
+draining server exactly like a draining trainer.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from tpuddp.observability import MetricsWriter, schema
+from tpuddp.serving import queue as queue_mod
+from tpuddp.serving.queue import AdmissionError, Request, RequestQueue, ServedResult
+from tpuddp.serving.replica import Replica, ReplicaPool
+from tpuddp.serving.scheduler import BatchScheduler
+from tpuddp.serving.stats import ServingStats
+
+logger = logging.getLogger("tpuddp")
+
+
+class ServingEngine:
+    """Continuous-batching inference over a replica pool. See module doc."""
+
+    def __init__(
+        self,
+        pool: ReplicaPool,
+        max_batch_size: int = 32,
+        max_queue_depth: int = 256,
+        per_tenant_quota: Optional[int] = None,
+        batch_timeout_ms: float = 2.0,
+        stats_window: int = 64,
+        out_dir: Optional[str] = None,
+        config: Optional[dict] = None,
+    ):
+        self.pool = pool
+        self.queue = RequestQueue(max_queue_depth, per_tenant_quota)
+        self.scheduler = BatchScheduler(
+            self.queue, max_batch_size, batch_timeout_ms
+        )
+        self.writer = MetricsWriter(out_dir) if out_dir else None
+        self.stats = ServingStats(self.writer, window=stats_window)
+        self._config = dict(config or {})
+        self._threads: List[threading.Thread] = []
+        self._started = False
+        self._drained = False
+
+    @classmethod
+    def from_config(
+        cls, cfg: dict, out_dir: Optional[str] = None, devices=None
+    ) -> "ServingEngine":
+        """Build pool + engine from a ``serving`` config block
+        (tpuddp/config.py:SERVING_DEFAULTS / serving_config)."""
+        pool = ReplicaPool.from_config(cfg, devices=devices)
+        quota = cfg.get("per_tenant_quota")
+        return cls(
+            pool,
+            max_batch_size=int(cfg["max_batch_size"]),
+            max_queue_depth=int(cfg["max_queue_depth"]),
+            per_tenant_quota=None if quota is None else int(quota),
+            batch_timeout_ms=float(cfg["batch_timeout_ms"]),
+            stats_window=int(cfg["stats_window"]),
+            out_dir=out_dir,
+            config=cfg,
+        )
+
+    # ------------------------------------------------------------- lifecycle --
+    def start(self, warmup: bool = True) -> "ServingEngine":
+        if self._started:
+            return self
+        if self.writer is not None:
+            cfg = self._config
+            self.writer.write(
+                schema.make_run_meta(
+                    world_size=len(self.pool),
+                    comm_hook=None,
+                    guard=None,
+                    extra={
+                        "api": "serving",
+                        "model": cfg.get("model"),
+                        "num_replicas": len(self.pool),
+                        "max_batch_size": self.scheduler.max_batch_size,
+                        "max_queue_depth": self.queue.max_depth,
+                        "per_tenant_quota": self.queue.per_tenant_quota,
+                        "batch_timeout_ms": (
+                            self.scheduler.batch_timeout_s * 1e3
+                        ),
+                        "buckets": self.scheduler.buckets,
+                        "input_shape": list(self.pool.sample_shape),
+                        "restored_epoch": self.pool.restored_epoch,
+                        "checkpoint_dir": cfg.get("checkpoint_dir"),
+                        "config_hash": schema.config_hash(cfg or None),
+                    },
+                )
+            )
+        if warmup:
+            t0 = time.perf_counter()
+            self.pool.warmup(self.scheduler.buckets)
+            logger.info(
+                "serving: %d replica(s) warm over buckets %s in %.1fs",
+                len(self.pool), self.scheduler.buckets,
+                time.perf_counter() - t0,
+            )
+        # window 0's throughput must measure serving, not bucket compiles
+        self.stats.reset_clock()
+        for replica in self.pool.replicas:
+            t = threading.Thread(
+                target=self._dispatch_loop,
+                args=(replica,),
+                name=f"tpuddp-serve-r{replica.index}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        self._started = True
+        return self
+
+    def drain(self, reason: str = "shutdown", timeout: Optional[float] = None) -> dict:
+        """Close admission, finish queued + in-flight work, flush stats.
+        Idempotent; returns the final :meth:`ServingStats.summary`.
+
+        With a ``timeout``, dispatch threads may outlive the join — then the
+        stats are NOT finalized and the writer stays open (the still-running
+        loops keep recording honestly); call ``drain`` again to finish."""
+        self.queue.close()
+        for t in self._threads:
+            t.join(timeout)
+        alive = [t.name for t in self._threads if t.is_alive()]
+        if alive:
+            logger.warning(
+                "serving: dispatch thread(s) %s still running after the "
+                "drain timeout; stats not finalized yet", alive,
+            )
+            return self.stats.summary()
+        if not self._drained:
+            self._drained = True
+            self.stats.flush_window()
+            if self.writer is not None:
+                self.writer.write(
+                    schema.stamp(
+                        "event",
+                        {
+                            "event": "serving_drain",
+                            "reason": reason,
+                            **{
+                                k: v
+                                for k, v in self.stats.summary().items()
+                                if k in (
+                                    "submitted", "completed", "rejected",
+                                    "batches", "throughput_rps",
+                                )
+                            },
+                        },
+                    )
+                )
+                self.writer.close()
+        return self.stats.summary()
+
+    # --------------------------------------------------------------- client --
+    def submit(self, tenant: str, x: np.ndarray) -> ServedResult:
+        """Admit one request of ``(rows, *sample_shape)`` float32 rows.
+        Raises :class:`AdmissionError` (reason queue_full / tenant_quota /
+        draining / oversized / bad_shape) or returns the result future."""
+        x = np.asarray(x)
+        self.stats.record_submit()
+        try:
+            if x.ndim != 1 + len(self.pool.sample_shape) or (
+                tuple(x.shape[1:]) != self.pool.sample_shape
+            ):
+                raise AdmissionError(
+                    queue_mod.REJECT_BAD_SHAPE,
+                    f"rows of shape {tuple(x.shape[1:])} != the served "
+                    f"model's sample shape {self.pool.sample_shape}",
+                )
+            if x.dtype != np.float32:
+                raise AdmissionError(
+                    queue_mod.REJECT_BAD_SHAPE,
+                    f"dtype {x.dtype} != float32",
+                )
+            if x.shape[0] < 1:
+                raise AdmissionError(
+                    queue_mod.REJECT_BAD_SHAPE, "empty request (0 rows)"
+                )
+            if x.shape[0] > self.scheduler.max_batch_size:
+                raise AdmissionError(
+                    queue_mod.REJECT_OVERSIZED,
+                    f"{x.shape[0]} rows > max_batch_size="
+                    f"{self.scheduler.max_batch_size}; split the request",
+                )
+            # own the rows: a client reusing (mutating) its submit buffer
+            # must not rewrite a still-queued request's inputs
+            request = Request(tenant, np.array(x, copy=True))
+            self.queue.put(request)
+        except AdmissionError as e:
+            self.stats.record_reject(tenant, e.reason)
+            raise
+        return request.result
+
+    # -------------------------------------------------------------- dispatch --
+    def _dispatch_loop(self, replica: Replica) -> None:
+        """One replica's life: pull, dispatch, deliver, repeat — exits when
+        the queue closes and drains. A failed dispatch fails its batch's
+        requests (never the loop): clients see the exception through their
+        future, the next batch proceeds."""
+        while True:
+            batch = self.scheduler.next_batch()
+            if batch is None:
+                return
+            t_dispatch = time.perf_counter()
+            try:
+                logits = np.asarray(replica.infer(batch.x))  # fetch = fence
+            except BaseException as e:  # noqa: BLE001 — delivered to clients
+                logger.exception(
+                    "serving: dispatch failed on replica %d", replica.index
+                )
+                for r in batch.requests:
+                    r.result._deliver(None, error=e)
+                if self.writer is not None:
+                    self.writer.write(
+                        schema.stamp(
+                            "event",
+                            {
+                                "event": "serving_dispatch_error",
+                                "replica": replica.index,
+                                "error": repr(e),
+                                "requests": len(batch.requests),
+                            },
+                        )
+                    )
+                continue
+            t_done = time.perf_counter()
+            for r, (lo, hi) in zip(batch.requests, batch.slices):
+                # copy, don't view: a view would pin the whole padded
+                # bucket's logits per result and alias clients to each other
+                r.result._deliver(logits[lo:hi].copy())
+            self.stats.record_batch(batch, t_dispatch, t_done)

@@ -1,0 +1,189 @@
+"""Replica pool — N independent model replicas across the local devices.
+
+Training runs the mesh as ONE lockstep program; serving inverts that: each
+local device holds a full parameter copy and runs its own dispatch loop, so
+the mesh behaves as a pool of independently schedulable replicas (the MPMD
+view of PAPERS.md arxiv 2412.14374). Parameters are committed per device
+with ``jax.device_put``; a replica's jitted forward then follows its
+committed arguments, so concurrent dispatch loops land on distinct chips
+with no cross-replica coordination at all.
+
+Checkpoints come through the existing integrity-manifest path
+(``training/checkpoint.restore_latest``): sha256-verified, corrupt files
+skipped newest-first. Both checkpoint families restore — native
+``ckpt_{e}.npz`` files (TrainState attribute-keyed leaves) and managed
+``state_{e}.npz`` files (dict-keyed) — via a template whose pytree paths
+match the writer's; serving only reads the ``params``/``model_state``
+leaves, optimizer state stays untouched on disk.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuddp.models import load_model
+from tpuddp.nn.core import Context, Module
+from tpuddp.training import checkpoint as ckpt
+
+logger = logging.getLogger("tpuddp")
+
+
+@dataclasses.dataclass
+class _NativeSlice:
+    """Template matching the leading fields of the native ``TrainState``
+    checkpoint: attribute-keyed paths (``.params[...]``), so ``ckpt.load``
+    finds the same leaf names ``save_on_main`` wrote, while the optimizer
+    state / RNG / counters the serving path doesn't need are simply absent
+    from the template (extra stored keys are ignored by design)."""
+
+    params: Any
+    model_state: Any
+
+
+jax.tree_util.register_dataclass(
+    _NativeSlice, data_fields=["params", "model_state"], meta_fields=[]
+)
+
+
+def _restore_variables(
+    save_dir: str, prefix: str, params, model_state
+) -> Tuple[Any, Any, int]:
+    """Restore (params, model_state, epoch) from the newest intact
+    checkpoint. ``prefix="auto"`` picks whichever family ("ckpt" native /
+    "state" managed) has the newest intact file. Raises when nothing intact
+    exists — serving random weights because a directory was empty or corrupt
+    would be a silent catastrophe, unlike training's fresh-start resume."""
+    prefixes = ("ckpt", "state") if prefix == "auto" else (prefix,)
+    found = []
+    for p in prefixes:
+        hit = ckpt.latest(save_dir, prefix=p)
+        if hit is not None:
+            found.append((hit[1], p, hit[0]))
+    if not found:
+        raise FileNotFoundError(
+            f"no intact checkpoint with prefix(es) {prefixes} in {save_dir!r}"
+        )
+    epoch, pfx, path = max(found)
+    if pfx == "ckpt":
+        like: Any = _NativeSlice(params=params, model_state=model_state)
+        tree = ckpt.load(path, like)
+        out = (tree.params, tree.model_state)
+    else:
+        like = {"params": params, "model_state": model_state}
+        tree = ckpt.load(path, like)
+        out = (tree["params"], tree["model_state"])
+    logger.info("serving: restored %s (epoch %d)", path, epoch)
+    return out[0], out[1], epoch
+
+
+class Replica:
+    """One device's copy of the model: committed parameters + a private
+    jitted eval forward (one compiled program per batch bucket)."""
+
+    def __init__(self, index: int, device, module: Module, params, model_state):
+        self.index = index
+        self.device = device
+        self.module = module
+        self.params = jax.device_put(params, device)
+        self.model_state = jax.device_put(model_state, device)
+
+        def fwd(p, s, x):
+            # eval-mode forward, the FusedEvaluator's exact context: no
+            # dropout, BatchNorm on running stats, fixed throwaway key —
+            # rows are independent, so served logits are bitwise those of a
+            # direct forward over the same padded batch
+            ctx = Context(train=False, rng=jax.random.key(0), axis_name=None)
+            logits, _ = module.apply(p, s, x, ctx)
+            return logits
+
+        self._fwd = jax.jit(fwd)
+        self.dispatches = 0
+
+    def infer(self, x) -> jax.Array:
+        """Dispatch one padded batch; returns device logits (async — the
+        caller fences when it fetches rows)."""
+        self.dispatches += 1
+        return self._fwd(self.params, self.model_state, x)
+
+    def warmup(self, buckets, sample_shape, dtype=np.float32) -> None:
+        """Compile every bucket program now, so the first real request never
+        pays a compile in its latency."""
+        for b in buckets:
+            x = np.zeros((b,) + tuple(sample_shape), dtype)
+            jax.block_until_ready(self.infer(x))
+        self.dispatches = 0
+
+
+class ReplicaPool:
+    """The model replicas a :class:`ServingEngine` dispatches onto."""
+
+    def __init__(
+        self,
+        module: Module,
+        params,
+        model_state,
+        devices: List,
+        sample_shape: Tuple[int, ...],
+        restored_epoch: Optional[int] = None,
+    ):
+        self.module = module
+        self.sample_shape = tuple(int(d) for d in sample_shape)
+        self.restored_epoch = restored_epoch
+        self.replicas = [
+            Replica(i, d, module, params, model_state)
+            for i, d in enumerate(devices)
+        ]
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    @property
+    def devices(self):
+        return [r.device for r in self.replicas]
+
+    def warmup(self, buckets) -> None:
+        for r in self.replicas:
+            r.warmup(buckets, self.sample_shape)
+
+    @classmethod
+    def from_config(cls, cfg: dict, devices=None) -> "ReplicaPool":
+        """Build the pool from a ``serving`` config block
+        (tpuddp/config.py:SERVING_DEFAULTS): model zoo lookup, fresh seeded
+        init, then optional checkpoint restore over it."""
+        sample_shape = tuple(int(d) for d in cfg["input_shape"])
+        module = load_model(cfg["model"], num_classes=int(cfg["num_classes"]))
+        sample = jnp.zeros((1,) + sample_shape, jnp.float32)
+        params, model_state = module.init(
+            jax.random.key(int(cfg.get("seed") or 0)), sample
+        )
+        restored_epoch = None
+        if cfg.get("checkpoint_dir"):
+            params, model_state, restored_epoch = _restore_variables(
+                cfg["checkpoint_dir"],
+                str(cfg.get("checkpoint_prefix") or "auto"),
+                params,
+                model_state,
+            )
+        if devices is None:
+            devices = jax.local_devices()
+        n = cfg.get("num_replicas", "auto")
+        if n != "auto":
+            n = int(n)
+            if n < 1:
+                raise ValueError(f"num_replicas must be >= 1, got {n}")
+            if n > len(devices):
+                raise ValueError(
+                    f"num_replicas={n} exceeds the {len(devices)} available "
+                    "local devices"
+                )
+            devices = devices[:n]
+        return cls(
+            module, params, model_state, list(devices), sample_shape,
+            restored_epoch,
+        )

@@ -45,6 +45,7 @@ from tpuddp.observability import (
 )
 from tpuddp.observability import telemetry as telemetry_lib
 from tpuddp.training import checkpoint as ckpt
+from tpuddp.utils import batching
 from tpuddp.training.step import accumulate_metrics, finalize_metrics, stack_batches
 
 logger = logging.getLogger("tpuddp")
@@ -56,7 +57,9 @@ _AUTO_SCAN_CAP = 64  # A/B-measured on AlexNet b128 across three r5 tunnel
 # pure win with no semantic cost. This is the depth the bench's CNN rows
 # publish — the product default and the bench agree.
 _AUTO_SCAN_FALLBACK_CAP = 32  # when the staged-chunk size cannot be known
-_STAGE_BYTES_BUDGET = 256 * 1024 * 1024  # bound on one staged (K, batch) chunk
+# bound on one staged (K, batch) chunk — the shared budget every auto depth
+# policy (native scan, managed fuse, eval fusion, serving) caps against
+_STAGE_BYTES_BUDGET = batching.STAGE_BYTES_BUDGET
 _SMALL_PARAM_BYTES = 4 * 1024 * 1024
 
 
@@ -83,10 +86,10 @@ def resolve_scan_steps(
     if scan_steps in (None, "auto"):
         small = param_bytes is not None and param_bytes < _SMALL_PARAM_BYTES
         cap = _AUTO_SCAN_CAP if (small or batch_nbytes) else _AUTO_SCAN_FALLBACK_CAP
-        if batch_nbytes:
-            # the staging budget binds regardless of model size — a small
-            # model on large inputs still stages K x batch bytes
-            cap = max(1, min(cap, _STAGE_BYTES_BUDGET // int(batch_nbytes)))
+        # the staging budget binds regardless of model size — a small model
+        # on large inputs still stages K x batch bytes (shared cap policy,
+        # tpuddp/utils/batching.py)
+        cap = batching.resolve_fuse(batch_nbytes, cap=cap)
         return max(1, min(cap, n_batches))
     k = int(scan_steps)
     if k < 1:
