@@ -1,0 +1,58 @@
+"""Managed-API training worker for the chaos suite (launched by
+test_chaos.py) — the Accelerator-entrypoint sibling of _chaos_train_worker.
+
+Runs a small managed training job (toy MLP, synthetic-fallback data, virtual
+CPU devices) through ``basic_accelerate_training`` with the resilience wiring
+live: SIGTERM drain at loop boundaries -> lossless state_{epoch}.npz + exit
+75, ``$TPUDDP_FAULT`` epoch-site injection, ``$TPUDDP_AUTO_RESUME`` resume
+through ``load_state`` — which reshards elastically when
+``$TPUDDP_WORLD_SIZE`` differs from the world that wrote the state.
+
+Usage: python _chaos_accel_worker.py <out_dir> <num_epochs>
+
+``$TPUDDP_CHAOS_TRAINING``: JSON training-config overrides (same contract as
+the native worker). ``$TPUDDP_WORLD_SIZE``: world size (default 4).
+"""
+
+import json
+import os
+import sys
+
+out_dir, num_epochs = sys.argv[1], int(sys.argv[2])
+world_size = int(os.environ.get("TPUDDP_WORLD_SIZE") or 4)
+
+from tpuddp.parallel.spawn import maybe_reexec_for_world  # noqa: E402
+
+maybe_reexec_for_world(world_size, "cpu")
+
+from tpuddp.resilience.guard import ReplicaDesync  # noqa: E402
+from tpuddp.resilience.preemption import (  # noqa: E402
+    EXIT_DESYNC,
+    EXIT_PREEMPTED,
+    TrainingPreempted,
+)
+from train_accelerate import basic_accelerate_training  # noqa: E402
+
+TRAINING = {
+    "model": "toy_mlp",
+    "dataset": "cifar10",
+    "data_root": "/nonexistent",  # forces the zero-egress synthetic fallback
+    "train_batch_size": 8,  # per replica
+    "test_batch_size": 8,
+    "learning_rate": 0.01,
+    "num_epochs": num_epochs,
+    "checkpoint_epoch": 1,
+    "image_size": None,
+    "seed": 0,
+    "synthetic_n": (256, 64),
+}
+TRAINING.update(json.loads(os.environ.get("TPUDDP_CHAOS_TRAINING") or "{}"))
+
+try:
+    basic_accelerate_training(out_dir, TRAINING, num_chips=world_size)
+except TrainingPreempted as e:
+    print(f"{e}; exiting {EXIT_PREEMPTED} (requeue+resume)")
+    sys.exit(EXIT_PREEMPTED)
+except ReplicaDesync as e:
+    print(f"{e}; exiting {EXIT_DESYNC}")
+    sys.exit(EXIT_DESYNC)

@@ -10,6 +10,9 @@ Usage: python _chaos_train_worker.py <out_dir> <num_epochs>
 ``$TPUDDP_CHAOS_TRAINING`` may hold a JSON object of training-config
 overrides (e.g. ``{"guard": {"max_consecutive_skips": 0}}``) so chaos
 scenarios can arm the numerical guard without a worker per knob.
+``$TPUDDP_WORLD_SIZE`` overrides the 4-device default world — the elastic
+chaos matrix (and the restart supervisor's shrink policy) resumes the same
+out_dir on a different world size through the v2 reshard path.
 """
 
 import json
@@ -18,6 +21,7 @@ import sys
 from functools import partial
 
 out_dir, num_epochs = sys.argv[1], int(sys.argv[2])
+world_size = int(os.environ.get("TPUDDP_WORLD_SIZE") or 4)
 
 from tpuddp.parallel.spawn import run_ddp_training  # noqa: E402
 from train_native import basic_ddp_training_loop  # noqa: E402
@@ -40,7 +44,7 @@ TRAINING.update(json.loads(os.environ.get("TPUDDP_CHAOS_TRAINING") or "{}"))
 
 run_ddp_training(
     partial(basic_ddp_training_loop, training=TRAINING),
-    world_size=4,
+    world_size=world_size,
     save_dir=out_dir,
     optional_args={"set_epoch": True, "print_rand": False},
     backend="cpu",

@@ -38,8 +38,10 @@ pytestmark = [pytest.mark.chaos, pytest.mark.slow]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TRAIN_WORKER = os.path.join(REPO, "tests", "_chaos_train_worker.py")
+ACCEL_WORKER = os.path.join(REPO, "tests", "_chaos_accel_worker.py")
 HANG_WORKER = os.path.join(REPO, "tests", "_chaos_hang_worker.py")
 DESYNC_WORKER = os.path.join(REPO, "tests", "_chaos_desync_worker.py")
+SUPERVISE = os.path.join(REPO, "tools", "supervise.py")
 
 
 def chaos_env(**extra):
@@ -48,7 +50,7 @@ def chaos_env(**extra):
     env.pop("PALLAS_AXON_POOL_IPS", None)
     for k in (
         "TPUDDP_FAULT", "TPUDDP_AUTO_RESUME", "TPUDDP_WATCHDOG_TIMEOUT",
-        "TPUDDP_CHAOS_TRAINING", "TPUDDP_DEBUG_NANS",
+        "TPUDDP_CHAOS_TRAINING", "TPUDDP_DEBUG_NANS", "TPUDDP_WORLD_SIZE",
     ):
         env.pop(k, None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -59,11 +61,31 @@ def chaos_env(**extra):
     return env
 
 
-def run_train_worker(out_dir, epochs, env, timeout=300):
+def run_train_worker(out_dir, epochs, env, timeout=300, worker=TRAIN_WORKER):
     return subprocess.run(
-        [sys.executable, "-u", TRAIN_WORKER, str(out_dir), str(epochs)],
+        [sys.executable, "-u", worker, str(out_dir), str(epochs)],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout,
     )
+
+
+def validate_history(out_dir):
+    """tpuddp_inspect --validate must accept the (merged, multi-run)
+    history.jsonl — the schema-v2 stream the elastic matrix asserts."""
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "tpuddp_inspect.py"),
+            "--validate", os.path.join(str(out_dir), "history.jsonl"),
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def topology_events(out_dir):
+    return [
+        r for r in history_records(out_dir)
+        if r.get("event") == "topology_change"
+    ]
 
 
 def history_records(out_dir):
@@ -248,6 +270,275 @@ def test_desync_rollback_recovers_and_finishes(tmp_path):
     events = [r for r in rows if r.get("event") == "rollback"]
     assert events and events[0]["resume_epoch"] == 1
     assert [r["epoch"] for r in rows if "train_loss" in r] == [0, 1, 2]
+
+
+ELASTIC_CFG = {"comm_hook": "bf16_ef", "flip": False}  # bf16_ef arms the
+# per-replica error-feedback residual — the hardest state to move between
+# world sizes; flip off keeps the trajectory partition-independent so the
+# parity leg compares like with like.
+
+
+def _elastic_training(world_bs, **extra):
+    cfg = dict(ELASTIC_CFG)
+    cfg.update(train_batch_size=world_bs, test_batch_size=world_bs)
+    cfg.update(extra)
+    return json.dumps(cfg)
+
+
+def test_elastic_shrink_resume_with_loss_parity(tmp_path):
+    """ISSUE 7 chaos proof, headline leg: a bf16_ef run killed on 4 devices
+    at the epoch-2 boundary resumes on 2 devices (same GLOBAL batch: the
+    per-replica batch size doubles) through the elastic v2 restore — the
+    residual redistributes sum-preservingly (M | N: no reset), a
+    topology-change event row lands in history.jsonl, the merged stream
+    validates as schema v2, and the post-resume loss trajectory matches an
+    uninterrupted same-seed 4-device run (the trajectory only moves by the
+    partition's f32/bf16 reassociation, not by any lost state)."""
+    epochs = 4
+    # uninterrupted baseline, world 4 x bs 8 (global 32)
+    base_dir = tmp_path / "baseline"
+    base = run_train_worker(
+        base_dir, epochs,
+        env=chaos_env(TPUDDP_CHAOS_TRAINING=_elastic_training(8)),
+    )
+    assert base.returncode == 0, base.stdout[-2000:] + base.stderr[-2000:]
+    base_rows = {
+        r["epoch"]: r for r in history_records(base_dir)
+        if r.get("type") == "epoch"
+    }
+
+    # killed run: same seed/config, preempted at the epoch-2 boundary
+    out = tmp_path / "elastic"
+    first = run_train_worker(
+        out, epochs,
+        env=chaos_env(
+            TPUDDP_CHAOS_TRAINING=_elastic_training(8),
+            TPUDDP_FAULT="preempt@epoch=2",
+        ),
+    )
+    assert first.returncode == EXIT_PREEMPTED, (
+        first.stdout[-2000:] + first.stderr[-2000:]
+    )
+    emergency = os.path.join(str(out), "ckpt_2.npz")
+    assert ckpt.read_meta(emergency) == {"epoch": 2, "completed": 0}
+    topo = ckpt.read_topology(emergency)
+    assert topo["world_size"] == 4
+    assert topo["leaves"][".comm_state"]["kind"] == "per_replica"
+
+    # resume on HALF the world, per-replica batch doubled (global unchanged)
+    resumed = run_train_worker(
+        out, epochs,
+        env=chaos_env(
+            TPUDDP_CHAOS_TRAINING=_elastic_training(16),
+            TPUDDP_AUTO_RESUME=1,
+            TPUDDP_WORLD_SIZE=2,
+        ),
+    )
+    assert resumed.returncode == 0, (
+        resumed.stdout[-2000:] + resumed.stderr[-2000:]
+    )
+    assert "Auto-resume: continuing from epoch 2." in resumed.stdout
+
+    # every epoch trained exactly once across the two runs
+    assert history_epochs(out) == list(range(epochs))
+    # the topology change is a typed, validated record
+    events = topology_events(out)
+    assert events and events[0]["from_world"] == 4
+    assert events[0]["to_world"] == 2
+    assert events[0]["residual"] == "redistributed"  # M | N: NO reset
+    assert ".comm_state" in events[0]["resharded_leaves"]
+    assert not any(
+        r.get("event") == "comm_state_reset" for r in history_records(out)
+    )
+    # the resumed run's header names its provenance
+    metas = [
+        r for r in history_records(out)
+        if r.get("type") == "run_meta" and r.get("resumed_from_world")
+    ]
+    assert metas and metas[0]["resumed_from_world"] == 4
+    assert metas[0]["world_size"] == 2
+    validate_history(out)
+
+    # loss-trajectory parity vs the uninterrupted run: epochs 0-1 ran on the
+    # identical world (bitwise-equal states feed epoch 2), epochs 2-3 see the
+    # SAME global batches partitioned 2-ways instead of 4 — only f32
+    # reduction order and per-replica bf16 rounding move, bounded small
+    el_rows = {
+        r["epoch"]: r for r in history_records(out) if r.get("type") == "epoch"
+    }
+    for e in range(epochs):
+        assert np.isfinite(el_rows[e]["train_loss"])
+        np.testing.assert_allclose(
+            el_rows[e]["train_loss"], base_rows[e]["train_loss"],
+            rtol=0.05, atol=0.05,
+            err_msg=f"epoch {e} train-loss parity broken",
+        )
+        np.testing.assert_allclose(
+            el_rows[e]["test_loss"], base_rows[e]["test_loss"],
+            rtol=0.05, atol=0.05,
+            err_msg=f"epoch {e} test-loss parity broken",
+        )
+
+
+def test_elastic_grow_resume_after_midepoch_kill(tmp_path):
+    """N < M leg: a 2-device run is killed MID-epoch (preempt@step fires
+    inside epoch 1's train pass) and resumes on 4 devices. The emergency
+    checkpoint carries mid-epoch state (completed=0 -> epoch 1 is redone
+    from it), the residual redistributes by placement (N | M), and the
+    finished stream validates."""
+    out = tmp_path / "grow"
+    first = run_train_worker(
+        out, 3,
+        env=chaos_env(
+            TPUDDP_CHAOS_TRAINING=_elastic_training(16),
+            TPUDDP_WORLD_SIZE=2,
+            TPUDDP_FAULT="preempt@step=12",  # epoch 1, batch 4 of 8
+        ),
+    )
+    assert first.returncode == EXIT_PREEMPTED, (
+        first.stdout[-2000:] + first.stderr[-2000:]
+    )
+    assert "preempt@step fired" in first.stdout + first.stderr
+    found = ckpt.latest(str(out))
+    assert found is not None
+    path, epoch = found
+    assert epoch == 1 and ckpt.read_meta(path)["completed"] == 0
+    assert ckpt.read_topology(path)["world_size"] == 2
+
+    resumed = run_train_worker(
+        out, 3,
+        env=chaos_env(
+            TPUDDP_CHAOS_TRAINING=_elastic_training(8),
+            TPUDDP_AUTO_RESUME=1,
+            TPUDDP_WORLD_SIZE=4,
+        ),
+    )
+    assert resumed.returncode == 0, (
+        resumed.stdout[-2000:] + resumed.stderr[-2000:]
+    )
+    assert "Auto-resume: continuing from epoch 1." in resumed.stdout
+    # run 1 completed epoch 0 only; the interrupted epoch 1 is redone on 4
+    assert history_epochs(out) == [0, 1, 2]
+    events = topology_events(out)
+    assert events and (events[0]["from_world"], events[0]["to_world"]) == (2, 4)
+    assert events[0]["residual"] == "redistributed"
+    validate_history(out)
+
+
+def test_elastic_resume_managed_entrypoint(tmp_path):
+    """Accelerator-entrypoint leg: a managed run with weight-update sharding
+    (flat world-padded moment vectors — the data_flat reshard) killed on 4
+    devices resumes on 2 through load_state's elastic path, lands the
+    topology-change event row, and finishes with a valid stream."""
+    cfg = {"weight_update_sharding": True, "flip": False}
+    out = tmp_path / "managed"
+    first = run_train_worker(
+        out, 4,
+        env=chaos_env(
+            TPUDDP_CHAOS_TRAINING=json.dumps(dict(cfg, train_batch_size=8,
+                                                  test_batch_size=8)),
+            TPUDDP_FAULT="preempt@epoch=2",
+        ),
+        worker=ACCEL_WORKER,
+    )
+    assert first.returncode == EXIT_PREEMPTED, (
+        first.stdout[-2000:] + first.stderr[-2000:]
+    )
+    # the managed drain publishes the last COMPLETED epoch's lossless state
+    found = ckpt.latest(str(out), prefix="state")
+    assert found is not None and found[1] == 1
+    assert ckpt.read_topology(found[0])["world_size"] == 4
+
+    resumed = run_train_worker(
+        out, 4,
+        env=chaos_env(
+            TPUDDP_CHAOS_TRAINING=json.dumps(dict(cfg, train_batch_size=16,
+                                                  test_batch_size=16)),
+            TPUDDP_AUTO_RESUME=1,
+            TPUDDP_WORLD_SIZE=2,
+        ),
+        worker=ACCEL_WORKER,
+    )
+    assert resumed.returncode == 0, (
+        resumed.stdout[-2000:] + resumed.stderr[-2000:]
+    )
+    assert "Resumed from epoch 1 state." in resumed.stdout
+    assert history_epochs(out) == [0, 1, 2, 3]
+    events = topology_events(out)
+    assert events and (events[0]["from_world"], events[0]["to_world"]) == (4, 2)
+    # WUS flat moments re-padded onto the smaller world
+    assert any(
+        leaf.startswith("['opt_state']")
+        for leaf in events[0]["resharded_leaves"]
+    ), events[0]
+    metas = [
+        r for r in history_records(out)
+        if r.get("type") == "run_meta" and r.get("resumed_from_world")
+    ]
+    assert metas and metas[0]["resumed_from_world"] == 4
+    validate_history(out)
+
+
+def test_elastic_mismatched_world_resets_residual(tmp_path):
+    """M∤N leg (4 -> 3): no sum-preserving redistribution exists, so the
+    bf16_ef residual RESETS — the run must still resume and finish, with the
+    documented comm_state_reset event row beside the topology change."""
+    out = tmp_path / "mismatch"
+    first = run_train_worker(
+        out, 3,
+        env=chaos_env(
+            TPUDDP_CHAOS_TRAINING=_elastic_training(8),
+            TPUDDP_FAULT="preempt@epoch=1",
+        ),
+    )
+    assert first.returncode == EXIT_PREEMPTED, (
+        first.stdout[-2000:] + first.stderr[-2000:]
+    )
+    resumed = run_train_worker(
+        out, 3,
+        env=chaos_env(
+            TPUDDP_CHAOS_TRAINING=_elastic_training(8),
+            TPUDDP_AUTO_RESUME=1,
+            TPUDDP_WORLD_SIZE=3,
+        ),
+    )
+    assert resumed.returncode == 0, (
+        resumed.stdout[-2000:] + resumed.stderr[-2000:]
+    )
+    events = topology_events(out)
+    assert events and events[0]["residual"] == "reset"
+    resets = [
+        r for r in history_records(out)
+        if r.get("event") == "comm_state_reset"
+    ]
+    assert resets and resets[0]["from_world"] == 4
+    assert resets[0]["to_world"] == 3
+    assert history_epochs(out) == [0, 1, 2]
+    validate_history(out)
+
+
+def test_supervisor_end_to_end_preempt_then_resume(tmp_path):
+    """The restart supervisor drives the whole cycle in ONE command: the
+    first attempt is preempted (injected fault, applied to attempt 0 only),
+    exits 75, and the supervisor relaunches the same argv with auto-resume —
+    the run finishes 0 with every epoch trained exactly once."""
+    env = chaos_env(TPUDDP_CHAOS_TRAINING=_elastic_training(8))
+    proc = subprocess.run(
+        [
+            sys.executable, "-u", SUPERVISE,
+            "--world", "4", "--max-restarts", "3",
+            "--backoff-base", "0.1", "--backoff-cap", "0.5",
+            "--first-env", "TPUDDP_FAULT=preempt@epoch=1",
+            "--",
+            sys.executable, "-u", TRAIN_WORKER, str(tmp_path), "3",
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    both = proc.stdout + proc.stderr
+    assert "resuming immediately" in both
+    assert history_epochs(tmp_path) == [0, 1, 2]
+    validate_history(tmp_path)
 
 
 def test_hang_at_barrier_detected_by_watchdog(tmp_path):

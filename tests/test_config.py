@@ -48,6 +48,16 @@ def test_world_size_absent_is_none():
     assert cfg.world_size_from({"local": {}}) is None
 
 
+def test_world_size_env_override_wins(monkeypatch):
+    """$TPUDDP_WORLD_SIZE (the restart supervisor's elastic shrink lever)
+    beats the settings file on both entrypoints' resolution path."""
+    monkeypatch.setenv("TPUDDP_WORLD_SIZE", "2")
+    assert cfg.world_size_from(BASE) == 2
+    assert cfg.world_size_from({"local": {}}) == 2
+    monkeypatch.delenv("TPUDDP_WORLD_SIZE")
+    assert cfg.world_size_from(BASE) == 8
+
+
 def test_device_validation():
     assert cfg.device_from({"local": {"device": "cpu"}}) == "cpu"
     with pytest.raises(ValueError):

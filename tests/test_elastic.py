@@ -1,0 +1,450 @@
+"""Elastic resume (ISSUE 7), fast tier: the v2 topology-change-tolerant
+checkpoint format, the N->M reshard rules (re-pad / sum-preserving residual
+redistribution / documented reset), v1 TopologyMismatch for both checkpoint
+families, the restore_latest quorum behavior over mixed prefixes, and the
+restart supervisor's exit-code policy (driven by a fake child runner — the
+subprocess proofs live in tests/test_chaos.py)."""
+
+import dataclasses
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpuddp import optim
+from tpuddp.models import ToyMLP
+from tpuddp.parallel import make_mesh
+from tpuddp.parallel.comm import redistribute_residual
+from tpuddp.parallel.ddp import DistributedDataParallel
+from tpuddp.resilience.preemption import (
+    EXIT_DESYNC,
+    EXIT_PREEMPTED,
+    EXIT_WATCHDOG,
+)
+from tpuddp.resilience.supervisor import RestartSupervisor, SupervisorPolicy
+from tpuddp.training import checkpoint as ckpt
+
+
+# ------------------------------------------------------ elastic checkpoints --
+
+
+def build_world(cpu_devices, world, **kw):
+    """A DDP wrap + initialized state on the first ``world`` devices, with
+    the two world-size-dependent state kinds armed: weight-update-sharded
+    flat optimizer moments and the shard_map bf16_ef per-replica residual."""
+    kw.setdefault("comm_hook", "bf16_ef")
+    kw.setdefault("weight_update_sharding", True)
+    mesh = make_mesh(cpu_devices[:world])
+    ddp = DistributedDataParallel(
+        ToyMLP(hidden=(8,)), optim.Adam(1e-2), mesh=mesh, **kw
+    )
+    state = ddp.init_state(jax.random.key(0), jnp.zeros((1, 4, 4, 3)))
+    return ddp, state
+
+
+def residual_matrix(ddp, rng_seed=0):
+    """A non-trivial (world, per) residual respecting the padding invariant
+    (zeros past the raw element count — what training guarantees)."""
+    spec = ddp._wus_spec
+    raw = sum(spec.sizes)
+    mat = np.zeros((ddp.world_size, spec.total), np.float32)
+    mat[:, :raw] = (
+        np.random.default_rng(rng_seed)
+        .normal(size=(ddp.world_size, raw))
+        .astype(np.float32)
+    )
+    return mat, raw
+
+
+def with_residual(ddp, state, mat):
+    return dataclasses.replace(
+        state,
+        comm_state=jax.device_put(
+            mat.reshape(-1), NamedSharding(ddp.mesh, P("data"))
+        ),
+    )
+
+
+def test_save_on_main_writes_v2_topology(cpu_devices, tmp_path):
+    ddp, state = build_world(cpu_devices, 4)
+    path = ckpt.save_on_main(str(tmp_path), 3, state, world_size=4)
+    topo = ckpt.read_topology(path)
+    assert topo["format"] == ckpt.FORMAT_VERSION
+    assert topo["world_size"] == 4
+    assert topo["mesh_axes"] == ["data"] and topo["mesh_shape"] == [4]
+    assert topo["leaves"][".comm_state"]["kind"] == "per_replica"
+    assert topo["leaves"][".comm_state"]["world"] == 4
+    # the meta scalar contract is unchanged (v1 readers see the same keys)
+    assert ckpt.read_meta(path) == {"epoch": 3, "completed": 1}
+    # every WUS flat moment vector is tagged for re-padding
+    flat_tags = [
+        k for k, v in topo["leaves"].items()
+        if v["kind"] == "data_flat" and k.startswith(".opt_state")
+    ]
+    assert flat_tags, topo["leaves"]
+
+
+def test_same_topology_restore_is_bitwise(cpu_devices, tmp_path):
+    ddp, state = build_world(cpu_devices, 4)
+    mat, _ = residual_matrix(ddp)
+    state = with_residual(ddp, state, mat)
+    ckpt.save_on_main(str(tmp_path), 2, state, world_size=4)
+    log = []
+    restored, nxt = ckpt.restore_latest(
+        str(tmp_path), state, world_size=4, reshard_log=log
+    )
+    assert nxt == 3
+    assert log == []  # same topology: no events, no reshard
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        dataclasses.replace(restored, rng=None),
+        dataclasses.replace(state, rng=None),
+    )
+
+
+def test_shrink_redistributes_residual_sum_preserving(cpu_devices, tmp_path):
+    """4 -> 2 (M | N): each new replica's residual is the elementwise f32 sum
+    of its group of two old rows — bitwise-reproducible, per-element sum over
+    the replica axis preserved exactly; WUS moments re-pad exactly."""
+    ddp4, s4 = build_world(cpu_devices, 4)
+    mat, raw = residual_matrix(ddp4)
+    per4 = ddp4._wus_spec.total
+    s4 = with_residual(ddp4, s4, mat)
+    ckpt.save_on_main(str(tmp_path), 5, s4, world_size=4)
+
+    ddp2, s2 = build_world(cpu_devices, 2)
+    per2 = ddp2._wus_spec.total
+    log = []
+    restored, nxt = ckpt.restore_latest(
+        str(tmp_path), s2, world_size=2, reshard_log=log
+    )
+    assert nxt == 6
+    got = np.asarray(restored.comm_state).reshape(2, per2)
+    cols = np.zeros((4, per2), np.float32)
+    keep = min(per4, per2)
+    cols[:, :keep] = mat[:, :keep]
+    expected = cols.reshape(2, 2, per2).sum(axis=1)
+    np.testing.assert_array_equal(got, expected)  # bitwise
+    # per-element replica-axis sum preserved (the trajectory-relevant value)
+    # up to one f32 rounding per group sum — the redistribution's only
+    # arithmetic
+    np.testing.assert_allclose(
+        got.astype(np.float64).sum(axis=0)[:raw],
+        mat.astype(np.float64).sum(axis=0)[:raw],
+        rtol=1e-5, atol=1e-5,
+    )
+    ev = [e for e in log if e["event"] == "topology_change"]
+    assert ev and ev[0]["from_world"] == 4 and ev[0]["to_world"] == 2
+    assert ev[0]["residual"] == "redistributed"
+    assert ".comm_state" in ev[0]["resharded_leaves"]
+    # params ride through untouched
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored.params, s4.params,
+    )
+
+
+def test_grow_places_residual_rows(cpu_devices, tmp_path):
+    """2 -> 4 (N | M): old row r lands verbatim at new row 2r, the rest are
+    zero — a pure placement, bitwise sum-preserving."""
+    ddp2, s2 = build_world(cpu_devices, 2)
+    mat, _ = residual_matrix(ddp2, rng_seed=1)
+    per2 = ddp2._wus_spec.total
+    s2 = with_residual(ddp2, s2, mat)
+    ckpt.save_on_main(str(tmp_path), 1, s2, world_size=2)
+
+    ddp4, s4 = build_world(cpu_devices, 4)
+    per4 = ddp4._wus_spec.total
+    log = []
+    restored, _ = ckpt.restore_latest(
+        str(tmp_path), s4, world_size=4, reshard_log=log
+    )
+    got = np.asarray(restored.comm_state).reshape(4, per4)
+    keep = min(per2, per4)
+    np.testing.assert_array_equal(got[0, :keep], mat[0, :keep])
+    np.testing.assert_array_equal(got[2, :keep], mat[1, :keep])
+    assert not got[1].any() and not got[3].any()
+    assert log[0]["residual"] == "redistributed"
+
+
+def test_no_divisor_relation_resets_residual_with_event(cpu_devices, tmp_path):
+    """4 -> 3 (M∤N, N∤M): the documented fallback — residual resets to zero
+    and a typed comm_state_reset event is handed back; moments still re-pad."""
+    ddp4, s4 = build_world(cpu_devices, 4)
+    mat, _ = residual_matrix(ddp4)
+    s4 = with_residual(ddp4, s4, mat)
+    ckpt.save_on_main(str(tmp_path), 0, s4, world_size=4)
+
+    ddp3, s3 = build_world(cpu_devices, 3)
+    log = []
+    restored, _ = ckpt.restore_latest(
+        str(tmp_path), s3, world_size=3, reshard_log=log
+    )
+    assert not np.asarray(restored.comm_state).any()
+    resets = [e for e in log if e["event"] == "comm_state_reset"]
+    assert resets and resets[0]["from_world"] == 4 and resets[0]["to_world"] == 3
+    topo_ev = [e for e in log if e["event"] == "topology_change"][0]
+    assert topo_ev["residual"] == "reset"
+
+
+def test_redistribute_residual_rules():
+    mat = np.arange(12, dtype=np.float32).reshape(4, 3)
+    same, action = redistribute_residual(mat, 4)
+    assert action == "unchanged" and same is mat or (same == mat).all()
+    shrunk, action = redistribute_residual(mat, 2)
+    assert action == "redistributed"
+    np.testing.assert_array_equal(shrunk, mat.reshape(2, 2, 3).sum(axis=1))
+    grown, action = redistribute_residual(mat, 8)
+    assert action == "redistributed"
+    np.testing.assert_array_equal(grown[::2], mat)
+    assert not grown[1::2].any()
+    reset, action = redistribute_residual(mat, 3)
+    assert action == "reset" and not reset.any()
+
+
+def test_nonzero_padding_tail_refuses_reshard(cpu_devices, tmp_path):
+    """A 'flat' vector whose tail past the new length is non-zero is NOT
+    world-multiple padding (a different model, not a different world):
+    truncation would silently lose data, so the fit refuses."""
+    ddp4, s4 = build_world(cpu_devices, 4)
+    mat = np.ones((4, ddp4._wus_spec.total), np.float32)  # non-zero tail
+    s4 = with_residual(ddp4, s4, mat)
+    ckpt.save_on_main(str(tmp_path), 0, s4, world_size=4)
+    ddp2, s2 = build_world(cpu_devices, 2)
+    if ddp2._wus_spec.total >= ddp4._wus_spec.total:
+        pytest.skip("padding layout coincides; no truncation to refuse")
+    with pytest.raises(ckpt.TopologyMismatch, match="not world-multiple padding"):
+        ckpt.restore_latest(str(tmp_path), s2, world_size=2)
+
+
+def test_per_replica_without_world_size_raises(cpu_devices, tmp_path):
+    ddp4, s4 = build_world(cpu_devices, 4)
+    mat, _ = residual_matrix(ddp4)
+    s4 = with_residual(ddp4, s4, mat)
+    path = ckpt.save_on_main(str(tmp_path), 0, s4, world_size=4)
+    _, s2 = build_world(cpu_devices, 2)
+    with pytest.raises(ckpt.TopologyMismatch, match="world size"):
+        ckpt.load(path, s2)  # no world_size: cannot redistribute
+
+
+# --------------------------------------- v1 family: clear TopologyMismatch --
+
+
+def test_v1_native_checkpoint_on_different_world_raises(cpu_devices, tmp_path):
+    """Satellite: a v1 (no topology record) native TrainState checkpoint
+    loaded onto a different world size must raise TopologyMismatch pointing
+    at elastic v2 — not reshape or silently mis-slice."""
+    ddp4, s4 = build_world(cpu_devices, 4)
+    path = str(tmp_path / "v1.npz")
+    ckpt.save(path, s4)  # plain save: v1 semantics, no topology
+    _, s2 = build_world(cpu_devices, 2)
+    with pytest.raises(ckpt.TopologyMismatch) as e:
+        ckpt.load(path, s2, world_size=2)
+    assert "v2" in str(e.value) or "topology record" in str(e.value)
+    # same topology keeps loading unchanged
+    restored = ckpt.load(path, s4)
+    np.testing.assert_array_equal(
+        np.asarray(restored.comm_state), np.asarray(s4.comm_state)
+    )
+
+
+def test_v1_managed_state_on_different_world_raises(tmp_path):
+    """Same contract for the managed dict-keyed ``state_{e}.npz`` family:
+    the WUS flat moment vector is world-padded, so a v1 file mismatches."""
+    tree4 = {
+        "params": {"w": np.ones((3, 2), np.float32)},
+        "opt_state": {"m": np.zeros(8, np.float32)},  # padded for world 4
+    }
+    path = str(tmp_path / "state_0.npz")
+    ckpt.save(path, tree4)
+    tree6 = {
+        "params": {"w": np.ones((3, 2), np.float32)},
+        "opt_state": {"m": np.zeros(6, np.float32)},  # padded for world 6
+    }
+    with pytest.raises(ckpt.TopologyMismatch, match="topology"):
+        ckpt.load(path, tree6, world_size=6)
+    # and an ordinary (non-world-dependent) mismatch stays a plain ValueError
+    bad = {"params": {"w": np.ones((4, 2), np.float32)},
+           "opt_state": {"m": np.zeros(8, np.float32)}}
+    with pytest.raises(ValueError) as e:
+        ckpt.load(path, bad)
+    assert not isinstance(e.value, ckpt.TopologyMismatch)
+
+
+# ----------------------------------------------- restore_latest quorum -----
+
+
+def test_restore_latest_quorum_mixed_prefixes(cpu_devices, tmp_path, caplog):
+    """Satellite: corrupted newest + intact older checkpoints across the
+    mixed prefix families (ckpt / state / auto): the skip is LOGGED, the
+    older epoch is re-derived correctly per family, and the serving 'auto'
+    prefix picks the newest intact file across BOTH families."""
+    from tpuddp.resilience import integrity
+    from tpuddp.serving.replica import _restore_variables
+
+    ddp, state = build_world(
+        cpu_devices, 2, comm_hook="none", weight_update_sharding=False
+    )
+
+    def corrupt(path):
+        with open(path, "r+b") as f:
+            f.seek(0)
+            f.write(b"\x00GARBAGE\x00" * 4)
+
+    # native family: intact epoch 0, corrupt epoch 2
+    ckpt.save_on_main(str(tmp_path), 0, state, world_size=2)
+    p2 = ckpt.save_on_main(str(tmp_path), 2, state, world_size=2)
+    corrupt(p2)
+    assert not integrity.verify_file(p2)
+    # managed family: intact epoch 1, corrupt epoch 3
+    managed = {"params": state.params, "model_state": state.model_state}
+    ckpt.save_on_main(str(tmp_path), 1, managed, prefix="state", world_size=2)
+    p3 = ckpt.save_on_main(str(tmp_path), 3, managed, prefix="state", world_size=2)
+    corrupt(p3)
+
+    with caplog.at_level(logging.WARNING, logger="tpuddp"):
+        restored, nxt = ckpt.restore_latest(str(tmp_path), state, world_size=2)
+    assert nxt == 1  # corrupt ckpt_2 skipped, intact ckpt_0 + 1
+    assert "failed integrity verification" in caplog.text
+
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="tpuddp"):
+        _, nxt_state = ckpt.restore_latest(
+            str(tmp_path), managed, prefix="state", world_size=2
+        )
+    assert nxt_state == 2  # corrupt state_3 skipped, intact state_1 + 1
+    assert "failed integrity verification" in caplog.text
+
+    # serving's auto prefix: newest INTACT across families is state_1
+    _, _, epoch = _restore_variables(
+        str(tmp_path), "auto", state.params, state.model_state
+    )
+    assert epoch == 1
+
+
+# ------------------------------------------------------- restart supervisor --
+
+
+class FakeRunner:
+    """Scripted child: pops the next exit code, records (argv, env)."""
+
+    def __init__(self, codes):
+        self.codes = list(codes)
+        self.calls = []
+
+    def __call__(self, argv, env):
+        self.calls.append((list(argv), dict(env)))
+        return self.codes.pop(0)
+
+
+def make_supervisor(codes, **kw):
+    sleeps = []
+    runner = FakeRunner(codes)
+    kw.setdefault("policy", SupervisorPolicy(backoff_base=0.01, backoff_cap=0.02))
+    sup = RestartSupervisor(
+        ["python", "train.py"], runner=runner, sleep=sleeps.append, **kw
+    )
+    return sup, runner, sleeps
+
+
+def test_supervisor_clean_exit_passthrough():
+    sup, runner, sleeps = make_supervisor([0])
+    assert sup.run() == 0
+    assert len(runner.calls) == 1 and sleeps == []
+
+
+def test_supervisor_resumes_preempted_child_immediately():
+    """75 -> restart NOW with auto-resume, no backoff; the restart env drops
+    the first attempt's injected fault and sets TPUDDP_AUTO_RESUME=1."""
+    sup, runner, sleeps = make_supervisor(
+        [EXIT_PREEMPTED, EXIT_PREEMPTED, 0],
+        first_attempt_env={"TPUDDP_FAULT": "preempt@epoch=1"},
+    )
+    assert sup.run() == 0
+    assert sleeps == []  # preemption never backs off
+    assert runner.calls[0][1]["TPUDDP_FAULT"] == "preempt@epoch=1"
+    assert "TPUDDP_AUTO_RESUME" not in runner.calls[0][1]
+    for _argv, env in runner.calls[1:]:
+        assert env["TPUDDP_AUTO_RESUME"] == "1"
+        assert "TPUDDP_FAULT" not in env  # chaos must not re-fire on resume
+    assert [h[1] for h in sup.history] == [EXIT_PREEMPTED, EXIT_PREEMPTED, 0]
+
+
+def test_supervisor_shrinks_world_on_repeated_peer_death():
+    """Two consecutive watchdog exits (76) shrink the world 8 -> 4 and resume
+    through the elastic path (TPUDDP_WORLD_SIZE re-pinned); the shrink resets
+    the peer-death streak."""
+    sup, runner, sleeps = make_supervisor(
+        [EXIT_WATCHDOG, EXIT_WATCHDOG, 0],
+        world_size=8,
+        policy=SupervisorPolicy(
+            backoff_base=0.01, backoff_cap=0.02, shrink_after=2
+        ),
+    )
+    assert sup.run() == 0
+    assert [h[2] for h in sup.history] == [8, 8, 4]
+    assert runner.calls[0][1]["TPUDDP_WORLD_SIZE"] == "8"
+    assert runner.calls[2][1]["TPUDDP_WORLD_SIZE"] == "4"
+    assert runner.calls[2][1]["TPUDDP_AUTO_RESUME"] == "1"
+    assert len(sleeps) == 1  # first 76 backs off; the shrink restarts at once
+
+
+def test_supervisor_min_world_blocks_shrink():
+    sup, runner, sleeps = make_supervisor(
+        [EXIT_WATCHDOG, EXIT_WATCHDOG, EXIT_WATCHDOG, 0],
+        world_size=2,
+        policy=SupervisorPolicy(
+            backoff_base=0.01, backoff_cap=0.02, shrink_after=2, min_world=2
+        ),
+    )
+    assert sup.run() == 0
+    assert all(h[2] == 2 for h in sup.history)  # never shrank below min
+    assert len(sleeps) == 3  # every 76 backed off instead
+
+
+def test_supervisor_restart_budget_surfaces_last_code():
+    sup, runner, sleeps = make_supervisor(
+        [EXIT_DESYNC, EXIT_DESYNC, EXIT_DESYNC],
+        policy=SupervisorPolicy(
+            max_restarts=2, backoff_base=0.01, backoff_cap=0.02
+        ),
+    )
+    assert sup.run() == EXIT_DESYNC
+    assert len(runner.calls) == 3  # initial + 2 restarts
+
+
+def test_supervisor_backoff_grows_and_is_jittered():
+    sleeps = []
+    runner = FakeRunner([1, 1, 1, 0])
+    sup = RestartSupervisor(
+        ["x"], runner=runner, sleep=sleeps.append,
+        policy=SupervisorPolicy(backoff_base=1.0, backoff_cap=100.0, jitter=0.5),
+    )
+    assert sup.run() == 0
+    assert len(sleeps) == 3
+    # delay(k) = base * 2^(k-1) * U(0.5, 1.5): bounds per consecutive failure
+    for k, d in enumerate(sleeps, start=1):
+        lo, hi = 2 ** (k - 1) * 0.5, 2 ** (k - 1) * 1.5
+        assert lo <= d <= hi
+
+
+def test_supervise_cli_parses_and_runs(tmp_path):
+    """tools/supervise.py end-to-end over a trivial child command."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(repo, "tools", "supervise.py"),
+            "--max-restarts", "1", "--", sys.executable, "-c", "print('ok')",
+        ],
+        capture_output=True, text=True, timeout=120, cwd=repo, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

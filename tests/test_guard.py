@@ -121,8 +121,16 @@ def test_resolve_guard_refuses_bad_input():
 def test_nan_fault_spec_grammar():
     specs = faults.parse_fault_specs("nan@step=5")
     assert specs[0].kind == "nan" and specs[0].site == "step" and specs[0].arg == "5"
-    with pytest.raises(ValueError, match="nan"):
-        faults.parse_fault_specs("crash@step=5")
+    # step=N also takes the process-killing kinds (the elastic-resume
+    # mid-epoch kill scenarios, ISSUE 7) ...
+    for kind in ("crash", "preempt"):
+        spec = faults.parse_fault_specs(f"{kind}@step=5")[0]
+        assert spec.kind == kind and spec.site == "step" and spec.arg == "5"
+    # ... but hang/corrupt at step=N stay typos, and nan stays step-only
+    with pytest.raises(ValueError, match="step"):
+        faults.parse_fault_specs("hang@step=5")
+    with pytest.raises(ValueError, match="step"):
+        faults.parse_fault_specs("corrupt@step=5")
     with pytest.raises(ValueError, match="nan"):
         faults.parse_fault_specs("nan@epoch=5")
 

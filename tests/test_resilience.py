@@ -479,6 +479,51 @@ def test_watchdog_start_disabled_paths(tmp_path, monkeypatch):
     watchdog.stop(None)  # None-safe
 
 
+def test_watchdog_shrunk_resume_ignores_leftover_heartbeats(tmp_path, monkeypatch):
+    """Regression (ISSUE 7 satellite): an elastically-shrunk resume reuses
+    the heartbeat_dir of a previous LARGER world. The leftover hb_{i} files —
+    both the ids past the new world size and the in-range ids with ancient
+    beats — must not make the watchdog kill the healthy smaller run with
+    exit 76: start() purges the out-of-range files, and check_once gives
+    pre-start beats the startup grace instead of declaring them stale."""
+    hb_dir = tmp_path / ".heartbeats"
+    os.makedirs(hb_dir)
+    ancient = time.time() - 3600.0
+    for peer in range(8):  # the previous 8-process world's droppings
+        watchdog.write_heartbeat(str(hb_dir), peer, now=ancient)
+
+    monkeypatch.setenv("TPUDDP_WATCHDOG_TIMEOUT", "5")
+    monkeypatch.delenv("TPUDDP_HEARTBEAT_DIR", raising=False)
+    pair = watchdog.start(str(tmp_path), 0, 2)  # resumed world: 2 processes
+    try:
+        assert pair is not None
+        _hb, wd = pair
+        # ids >= num_processes purged outright
+        leftover = sorted(os.listdir(hb_dir))
+        assert "hb_2" not in leftover and "hb_7" not in leftover
+        # peer 1's ancient file is pre-start: startup grace, NOT stale —
+        # before the fix this check returned [(1, ~3600s)] and fired exit 76
+        assert wd.check_once() == []
+        # the grace is not unconditional: past the timeout with still no
+        # fresh beat, the peer IS stale
+        stale = wd.check_once(now=time.time() + 10.0)
+        assert [p for p, _ in stale] == [1]
+        # and a fresh in-run beat clears it
+        watchdog.write_heartbeat(str(hb_dir), 1)
+        assert wd.check_once() == []
+    finally:
+        watchdog.stop(pair)
+
+
+def test_purge_stale_peers_counts_and_is_best_effort(tmp_path):
+    for peer in (0, 1, 4, 9):
+        watchdog.write_heartbeat(str(tmp_path), peer)
+    assert watchdog.purge_stale_peers(str(tmp_path), 2) == 2  # hb_4, hb_9
+    assert sorted(os.listdir(tmp_path)) == ["hb_0", "hb_1"]
+    assert watchdog.purge_stale_peers(str(tmp_path), 2) == 0  # idempotent
+    assert watchdog.purge_stale_peers(str(tmp_path / "missing"), 2) == 0
+
+
 # ------------------------------------------------------------ cifar download
 
 

@@ -274,6 +274,88 @@ def test_dispatch_error_fails_requests_not_engine(cpu_devices):
     assert summary["completed"] == 1
 
 
+def test_replica_marked_unhealthy_after_consecutive_errors(cpu_devices, tmp_path):
+    """Graceful degradation (ISSUE 7 satellite): K consecutive dispatch
+    errors mark a replica unhealthy and stop routing to it — a broken
+    replica must not fail batches forever. Healthy-replica traffic
+    continues, a replica_unhealthy event row lands in history.jsonl, and
+    drain still exits cleanly."""
+    K = 3
+    eng = ServingEngine.from_config(
+        _serving_cfg(num_replicas=2, unhealthy_after=K),
+        out_dir=str(tmp_path),
+        devices=cpu_devices[:2],
+    )
+    eng.start()
+    try:
+        broken = eng.pool.replicas[0]
+
+        def boom(x):
+            raise RuntimeError("injected persistent replica failure")
+
+        broken.infer = boom
+        failures = 0
+        served = 0
+        deadline = time.time() + 120
+        # keep submitting until the broken replica has eaten K batches and
+        # been retired; every request either fails (broken took it) or
+        # serves (healthy replica took it)
+        while broken.healthy and time.time() < deadline:
+            res = eng.submit("t", np.zeros((1,) + SHAPE, np.float32))
+            try:
+                res.result(timeout=60)
+                served += 1
+            except RuntimeError:
+                failures += 1
+        assert not broken.healthy, "replica never marked unhealthy"
+        assert failures >= K
+        # routing has stopped: from here on EVERY request lands healthy
+        for _ in range(8):
+            ok = eng.submit("t", np.zeros((2,) + SHAPE, np.float32))
+            assert ok.result(timeout=60).shape == (2, 10)
+            served += 1
+        assert eng.pool.replicas[1].healthy
+    finally:
+        summary = eng.drain()  # clean drain despite the dead replica
+    assert summary["completed"] == served
+    rows = [
+        json.loads(line)
+        for line in open(os.path.join(str(tmp_path), "history.jsonl"))
+    ]
+    unhealthy = [r for r in rows if r.get("event") == "replica_unhealthy"]
+    assert unhealthy and unhealthy[0]["replica"] == 0
+    assert unhealthy[0]["consecutive_errors"] == K
+    errs = schema.validate_history_records(rows)
+    assert errs == [], errs
+
+
+def test_last_replica_unhealthy_fails_queued_requests(cpu_devices, tmp_path):
+    """When the LAST healthy replica dies, queued requests must fail with an
+    error instead of hanging the client (and the drain)."""
+    eng = ServingEngine.from_config(
+        _serving_cfg(num_replicas=1, unhealthy_after=2),
+        out_dir=str(tmp_path),
+        devices=cpu_devices[:1],
+    )
+    eng.start()
+    try:
+        replica = eng.pool.replicas[0]
+        replica.infer = lambda x: (_ for _ in ()).throw(
+            RuntimeError("replica dead")
+        )
+        # sequential submits: each failure is its own batch, so the second
+        # one crosses unhealthy_after=2; later requests hit the no-healthy-
+        # replicas branch and still fail fast instead of hanging
+        for _ in range(4):
+            res = eng.submit("t", np.zeros((1,) + SHAPE, np.float32))
+            with pytest.raises(RuntimeError):
+                res.result(timeout=60)
+        assert not replica.healthy
+    finally:
+        summary = eng.drain()
+    assert summary["completed"] == 0
+
+
 def test_drain_then_submit_rejected(cpu_devices):
     eng = ServingEngine.from_config(
         _serving_cfg(num_replicas=1), devices=cpu_devices[:1]

@@ -31,6 +31,14 @@ events) and the latency-vs-throughput ``bench_results.json`` curve — must
 pass ``tpuddp_inspect --validate``. The serving SLO record stream drifting
 off schema v2 fails the gate the same way training telemetry drift does.
 
+Elastic-resume gate (after the serving gate): a bf16_ef training run on 4
+local devices is preempted (injected SIGTERM -> exit 75, emergency
+checkpoint), then resumed on 2 devices THROUGH the restart supervisor
+(tools/supervise.py) — the v2 checkpoint reshards onto the smaller world.
+The merged history.jsonl must validate and carry a topology_change event
+row; elastic restore drifting (a reshard that crashes, or stops recording
+its provenance) fails the gate here.
+
 Usage: python tools/run_full_gate.py [extra pytest args]
 
 The two-tier contract is documented in README "Testing"; the chaos tier can
@@ -131,6 +139,75 @@ def _serving_gate(env) -> int:
     return 0
 
 
+def _elastic_gate(env) -> int:
+    """Preempt a 4-device run, resume it on 2 via the supervisor, validate."""
+    import json
+
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    worker = os.path.join(REPO, "tests", "_chaos_train_worker.py")
+    with tempfile.TemporaryDirectory(prefix="tpuddp_elastic_gate_") as out_dir:
+        base_env = dict(env)
+        base_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        # leg 1: train on 4 devices with the bf16_ef residual armed; an
+        # injected preempt at the epoch-1 boundary drains to exit 75
+        env1 = dict(base_env)
+        env1.update({
+            "TPUDDP_WORLD_SIZE": "4",
+            "TPUDDP_FAULT": "preempt@epoch=1",
+            "TPUDDP_CHAOS_TRAINING": '{"comm_hook": "bf16_ef"}',
+        })
+        rc = subprocess.call(
+            [sys.executable, "-u", worker, out_dir, "3"],
+            cwd=REPO, env=env1,
+        )
+        if rc != 75:
+            print(f"elastic gate: preempted run exited {rc}, expected 75",
+                  file=sys.stderr)
+            return rc or 1
+        # leg 2: resume on 2 devices through the restart supervisor — the
+        # elastic v2 restore redistributes the residual onto the halved world
+        env2 = dict(base_env)
+        env2["TPUDDP_CHAOS_TRAINING"] = (
+            '{"comm_hook": "bf16_ef", "train_batch_size": 16, '
+            '"test_batch_size": 16}'
+        )
+        rc = subprocess.call(
+            [
+                sys.executable, "-u",
+                os.path.join(REPO, "tools", "supervise.py"),
+                "--world", "2", "--max-restarts", "2", "--auto-resume",
+                "--backoff-base", "0.2",
+                "--",
+                sys.executable, "-u", worker, out_dir, "3",
+            ],
+            cwd=REPO, env=env2,
+        )
+        if rc != 0:
+            print(f"elastic gate: supervised resume exited {rc}",
+                  file=sys.stderr)
+            return rc
+        history = os.path.join(out_dir, "history.jsonl")
+        rc = subprocess.call(
+            [sys.executable, inspect, "--validate", history],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("elastic gate: merged history.jsonl failed validation",
+                  file=sys.stderr)
+            return rc
+        with open(history) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        if not any(r.get("event") == "topology_change" for r in records):
+            print("elastic gate: no topology_change event row in the resumed "
+                  "history", file=sys.stderr)
+            return 1
+    return 0
+
+
 def main(argv=None):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")  # the full gate never needs a real TPU
@@ -146,7 +223,10 @@ def main(argv=None):
     rc = _schema_gate(env)
     if rc != 0:
         return rc
-    return _serving_gate(env)
+    rc = _serving_gate(env)
+    if rc != 0:
+        return rc
+    return _elastic_gate(env)
 
 
 if __name__ == "__main__":

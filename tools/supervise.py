@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Run a tpuddp training command under the restart supervisor.
+
+The supervisor (tpuddp/resilience/supervisor.py) interprets the exit-code
+contract the training processes already speak (README "Fault tolerance"):
+
+    0   done                         -> exit 0
+    75  preemption drain             -> resume IMMEDIATELY (auto-resume env)
+    76  stale peer (watchdog)        -> jittered-backoff restart; after
+                                        --shrink-after consecutive 76s,
+                                        SHRINK the world (--world // factor,
+                                        via $TPUDDP_WORLD_SIZE) and resume
+                                        through the elastic v2 restore
+    77  replica desync               -> jittered-backoff restart + resume
+    *   anything else non-zero       -> jittered-backoff restart + resume,
+                                        bounded by --max-restarts
+
+Usage::
+
+    python tools/supervise.py [options] -- <command> [args...]
+
+    # e.g. supervise a native run, starting on 8 chips, allowed to shrink
+    # to 2 after repeated peer death:
+    python tools/supervise.py --world 8 --min-world 2 -- \
+        python train_native.py --settings_file local_settings.yaml
+
+Options map 1:1 onto SupervisorPolicy; --first-env KEY=VAL applies env to
+the FIRST attempt only (chaos injection: the fault must not re-fire in the
+resumed child). --world pins $TPUDDP_WORLD_SIZE (both entrypoints honor it)
+and arms the shrink policy; without it the supervisor cannot shrink.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Restart supervisor for tpuddp training commands "
+        "(exit-code contract interpreter + elastic world shrink).",
+    )
+    parser.add_argument("--world", type=int, default=None,
+                        help="initial world size (pins $TPUDDP_WORLD_SIZE; "
+                        "required for elastic shrink)")
+    parser.add_argument("--max-restarts", type=int, default=8,
+                        help="total restart budget across all causes")
+    parser.add_argument("--backoff-base", type=float, default=1.0,
+                        help="first-failure backoff seconds")
+    parser.add_argument("--backoff-cap", type=float, default=60.0,
+                        help="backoff ceiling seconds")
+    parser.add_argument("--jitter", type=float, default=0.5,
+                        help="backoff jitter fraction in [0, 1]")
+    parser.add_argument("--shrink-after", type=int, default=2,
+                        help="consecutive peer-death exits (76) before the "
+                        "world shrinks")
+    parser.add_argument("--shrink-factor", type=int, default=2,
+                        help="world divisor per shrink step")
+    parser.add_argument("--min-world", type=int, default=1,
+                        help="never shrink below this world size")
+    parser.add_argument("--auto-resume", action="store_true",
+                        help="set $TPUDDP_AUTO_RESUME=1 on the FIRST attempt "
+                        "too (restarts always resume)")
+    parser.add_argument("--first-env", action="append", default=[],
+                        metavar="KEY=VAL",
+                        help="env applied to attempt 0 only (repeatable; "
+                        "e.g. --first-env TPUDDP_FAULT=preempt@epoch=1)")
+    if argv is None:
+        argv = sys.argv[1:]
+    if "--" not in argv:
+        parser.error("separate the supervised command with '--': "
+                     "supervise.py [options] -- <command> [args...]")
+    split = argv.index("--")
+    args = parser.parse_args(argv[:split])
+    command = argv[split + 1:]
+    if not command:
+        parser.error("no command after '--'")
+    return args, command
+
+
+def main(argv=None) -> int:
+    args, command = parse_args(argv)
+    first_env = {}
+    for kv in args.first_env:
+        if "=" not in kv:
+            raise SystemExit(f"--first-env expects KEY=VAL, got {kv!r}")
+        k, v = kv.split("=", 1)
+        first_env[k] = v
+
+    from tpuddp.resilience.supervisor import RestartSupervisor, SupervisorPolicy
+
+    policy = SupervisorPolicy(
+        max_restarts=args.max_restarts,
+        backoff_base=args.backoff_base,
+        backoff_cap=args.backoff_cap,
+        jitter=args.jitter,
+        shrink_after=args.shrink_after,
+        shrink_factor=args.shrink_factor,
+        min_world=args.min_world,
+    )
+    return RestartSupervisor(
+        command,
+        policy=policy,
+        world_size=args.world,
+        first_attempt_env=first_env,
+        auto_resume_first=args.auto_resume,
+    ).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1307,6 +1307,10 @@ class Accelerator:
         self.weight_update_sharding = bool(weight_update_sharding)
         self.comm_hook = comm_lib.validate_hook(comm_hook)
         self.guard = guard_lib.resolve_guard(guard)
+        # typed event dicts from the last load_state's elastic reshard (a
+        # topology_change when the restored state was written on a different
+        # world size); the managed entrypoint lands them in history.jsonl
+        self.last_restore_events: list = []
         self.bucket_cap_mb = float(bucket_cap_mb)
         if self.bucket_cap_mb <= 0:
             # same knob contract as DistributedDataParallel: a config that
@@ -1552,8 +1556,12 @@ class Accelerator:
             )
         tree = self._full_state_like(model, optimizer)
         # one writer discipline for every checkpoint flavor: cross-host
-        # gather (collective) -> process-0 write -> barrier
-        ckpt.save_on_main(save_dir, epoch, tree, prefix="state")
+        # gather (collective) -> process-0 write -> barrier; world_size
+        # stamps the v2 topology record so the state can reshard elastically
+        ckpt.save_on_main(
+            save_dir, epoch, tree, prefix="state",
+            world_size=int(self.mesh.devices.size),
+        )
 
     def load_state(
         self, model: PreparedModel, optimizer: "PreparedOptimizer", save_dir: str
@@ -1582,7 +1590,21 @@ class Accelerator:
             )
         like = self._full_state_like(model, optimizer)
         path, epoch = found
-        restored = ckpt.load(path, like)
+        # elastic resume: a state written on a different world size reshards
+        # onto THIS mesh (weight-update-sharded flat moments re-pad; the
+        # managed EF residual is a tree of parameter-shaped leaves, already
+        # world-independent). The reshard surfaces as typed event dicts in
+        # `last_restore_events` (the SAME construction the native driver
+        # uses) for the entrypoint to land in history.jsonl once the
+        # run_meta header exists.
+        world = int(self.mesh.devices.size)
+        actions: list = []
+        restored, topo = ckpt.load_with_topology(
+            path, like, world_size=world, reshard_actions=actions
+        )
+        self.last_restore_events = ckpt.build_reshard_events(
+            path, epoch, topo, world, actions
+        )
         next_epoch = epoch + 1
         model._params, model._model_state = replicate(
             self.mesh, (restored["params"], restored["model_state"])

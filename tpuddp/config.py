@@ -114,6 +114,10 @@ SERVING_DEFAULTS = {
     "batch_timeout_ms": 2.0,  # how long a dispatch loop waits for more rows
     # after the first request is in hand (latency/occupancy tradeoff)
     "stats_window": 64,  # completed requests per serving_stats history row
+    "unhealthy_after": 3,  # graceful degradation: K consecutive dispatch
+    # errors mark a replica unhealthy (stop routing to it, emit a
+    # replica_unhealthy event row); healthy replicas keep serving. 0 never
+    # marks (every batch on a broken replica fails individually).
     "seed": 0,  # fresh-init parameter seed (ignored with a checkpoint)
 }
 
@@ -193,8 +197,14 @@ def prepare_out_dir(settings: Dict[str, Any], settings_file: str) -> str:
 
 
 def world_size_from(settings: Dict[str, Any]) -> Optional[int]:
-    """World size: ``local.tpu.num_chips`` (TPU-native) or the reference's
-    ``local.condor.num_gpus`` (:306). None -> all local devices."""
+    """World size: ``$TPUDDP_WORLD_SIZE`` (the restart supervisor's elastic
+    override — tools/supervise.py shrinks a repeatedly-dying world by
+    re-launching the same command with this set), else ``local.tpu.num_chips``
+    (TPU-native) or the reference's ``local.condor.num_gpus`` (:306).
+    None -> all local devices."""
+    env = os.environ.get("TPUDDP_WORLD_SIZE")
+    if env:
+        return int(env)
     local = settings.get("local", {})
     if "tpu" in local and "num_chips" in local["tpu"]:
         return int(local["tpu"]["num_chips"])

@@ -289,3 +289,49 @@ def init_residual_tree(params):
     return jax.tree_util.tree_map(
         lambda p: jnp.zeros(np.shape(p), jnp.float32), params
     )
+
+
+def redistribute_residual(mat: np.ndarray, new_world: int) -> Tuple[np.ndarray, str]:
+    """Re-map per-replica error-feedback residuals onto a new world size —
+    the elastic-resume rule for ``TrainState.comm_state`` (DynamiQ's
+    dynamic-world-size compression-state motivation, arxiv.org/abs/2602.08923).
+
+    ``mat`` is the residual viewed as ``(old_world, per)``: row ``r`` is
+    replica ``r``'s accumulated compression error over the whole flat
+    gradient vector. What steers the trajectory is the SUM over replicas —
+    each replica adds its residual into its next send and the sends are
+    ``psum``'d — so any re-mapping that preserves the per-element sum over
+    the replica axis preserves the aggregate un-sent error budget:
+
+    - shrink, ``new_world`` divides ``old_world``: each new replica takes the
+      elementwise f32 sum of one group of ``old/new`` consecutive old rows
+      (``reshape(new, k, per).sum(axis=1)`` — exactly reproducible, so tests
+      can assert the redistribution bitwise);
+    - grow, ``old_world`` divides ``new_world``: old row ``r`` moves verbatim
+      to new row ``r * (new/old)``; the other rows start at zero (pure
+      placement — bitwise sum-preserving);
+    - no divisor relation (``M∤N`` both ways): there is no sum-preserving
+      alignment of whole rows, so the residual RESETS to zero — the
+      documented fallback. The un-sent error (bounded by one step's bf16
+      rounding per element) is dropped once; callers record a typed
+      ``comm_state_reset`` event row so the discontinuity is auditable.
+
+    Returns ``(new_mat, action)`` with ``action`` one of ``"unchanged"`` /
+    ``"redistributed"`` / ``"reset"``."""
+    mat = np.asarray(mat)
+    if mat.ndim != 2:
+        raise ValueError(f"expected a (world, per) residual view, got {mat.shape}")
+    old_world, per = mat.shape
+    if new_world < 1:
+        raise ValueError(f"new_world must be >= 1, got {new_world}")
+    if new_world == old_world:
+        return mat, "unchanged"
+    if old_world % new_world == 0:
+        k = old_world // new_world
+        return mat.reshape(new_world, k, per).sum(axis=1), "redistributed"
+    if new_world % old_world == 0:
+        k = new_world // old_world
+        out = np.zeros((new_world, per), mat.dtype)
+        out[::k] = mat
+        return out, "redistributed"
+    return np.zeros((new_world, per), mat.dtype), "reset"

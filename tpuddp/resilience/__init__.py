@@ -28,6 +28,13 @@ the way MLPerf-scale DDP work treats it (arxiv 1909.09756, 2509.07003):
                     desync auditor (``pmax - pmin`` fingerprints ->
                     exit 77 / rollback), and the skip counters the epoch
                     driver's rollback-to-last-good policy watches.
+- ``supervisor``  — the restart supervisor (ISSUE 7): runs the training
+                    command as a child, interprets the exit-code contract
+                    (75 -> resume now, 76/77/crash -> bounded
+                    jittered-backoff restart), and on repeated peer death
+                    shrinks ``$TPUDDP_WORLD_SIZE`` and resumes through the
+                    elastic v2 checkpoint restore instead of dying.
+                    CLI: ``tools/supervise.py``.
 """
 
 from tpuddp.resilience.preemption import (  # noqa: F401
@@ -71,6 +78,11 @@ from tpuddp.resilience.guard import (  # noqa: F401
     audit_params,
     resolve_guard,
 )
+from tpuddp.resilience.supervisor import (  # noqa: F401
+    RestartSupervisor,
+    SupervisorPolicy,
+    supervise,
+)
 
 __all__ = [
     "EXIT_INJECTED_CRASH",
@@ -106,4 +118,7 @@ __all__ = [
     "audit_or_raise",
     "audit_params",
     "resolve_guard",
+    "RestartSupervisor",
+    "SupervisorPolicy",
+    "supervise",
 ]

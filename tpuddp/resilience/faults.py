@@ -18,10 +18,16 @@ that crosses the process boundary without patching code.  Grammar::
             barrier  checked on entry to collectives.barrier
             ckpt_N   checked after checkpoint ``ckpt_N.npz`` is published
             step=N   checked per train micro-batch (global index from run
-                     start); ``nan`` only — the batch-level injection point
+                     start): ``nan`` poisons that batch (the batch-level
+                     injection point); ``crash``/``preempt`` kill the run
+                     MID-epoch — the elastic-resume resize scenarios
+                     (tests/test_chaos.py), where the drain's emergency
+                     checkpoint carries mid-epoch state and the resumed run
+                     (possibly on a different world size) redoes the epoch
 
 Examples: ``crash@epoch=2``, ``preempt@epoch=1``, ``hang@barrier``,
-``corrupt@ckpt_1``, ``nan@step=5``.  Each spec fires at most once per
+``corrupt@ckpt_1``, ``nan@step=5``, ``preempt@step=12``.  Each spec fires at
+most once per
 process.  Parsing is lazy and cached; :func:`reload_faults` re-reads the env
 (test isolation).  Production runs without the env variable pay one cached
 dict lookup per hook.
@@ -92,13 +98,21 @@ def parse_fault_specs(raw: str) -> List[FaultSpec]:
                 f"bad {_FAULT_ENV} site {point!r}; expected epoch=N, barrier, "
                 "ckpt_N, or step=N"
             )
-        # the step site is the batch-poisoning injection point and nan is its
-        # only meaningful kind (process-level kinds have the epoch site);
-        # refuse the cross products so a typo'd spec fails loudly
-        if (specs[-1].kind == "nan") != (specs[-1].site == "step"):
+        # kind/site pairing: nan only makes sense at the batch-level step
+        # site; the step site accepts nan (batch poisoning) plus the
+        # process-killing kinds crash/preempt (mid-epoch kills for the
+        # elastic chaos matrix). hang/corrupt at step=N would be typos —
+        # refuse them loudly.
+        spec = specs[-1]
+        if spec.kind == "nan" and spec.site != "step":
             raise ValueError(
                 f"bad {_FAULT_ENV} spec {part!r}: kind 'nan' pairs with site "
-                "step=N (and step=N only accepts 'nan')"
+                "step=N"
+            )
+        if spec.site == "step" and spec.kind not in ("nan", "crash", "preempt"):
+            raise ValueError(
+                f"bad {_FAULT_ENV} spec {part!r}: site step=N accepts kinds "
+                "'nan', 'crash', or 'preempt'"
             )
     return specs
 
@@ -130,6 +144,13 @@ def has_nan_fault() -> bool:
     return any(
         s.kind == "nan" and not s.fired for s in active_faults()
     )
+
+
+def has_step_fault() -> bool:
+    """True while ANY un-fired step-site spec is armed (nan poison or a
+    mid-epoch crash/preempt kill) — the epoch driver wires its per-batch
+    injection hook only then."""
+    return any(s.site == "step" and not s.fired for s in active_faults())
 
 
 def maybe_corrupt_batch(batch, step: int):
@@ -172,6 +193,9 @@ def maybe_fire(site: str, **ctx) -> None:
     ``site`` (+``ctx``); called from the epoch driver, barrier entry, and the
     checkpoint writer."""
     for spec in active_faults():
+        if spec.kind == "nan":
+            continue  # batch poisoning is maybe_corrupt_batch's job — firing
+            # it here would mark the spec consumed without poisoning anything
         if not spec.matches(site, **ctx):
             continue
         spec.fired = True

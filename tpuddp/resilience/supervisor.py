@@ -1,0 +1,218 @@
+"""Restart supervisor — the exit-code-contract interpreter (ISSUE 7).
+
+The resilience layer speaks in exit codes (resilience/preemption.py, README
+"Fault tolerance"): 75 = drained after preemption (requeue + auto-resume),
+76 = a peer's heartbeat went stale, 77 = replica desync, 113 = injected
+chaos crash. Until now something OUTSIDE the repo (HTCondor, a k8s operator,
+an engineer) had to read them. :class:`RestartSupervisor` is that something:
+it runs the training command as a child process, interprets the code it
+exits with, and restarts it under the right policy —
+
+- ``0``      — done; the supervisor exits 0.
+- ``75``     — a clean preemption drain: the emergency checkpoint is on
+  disk, so resume IMMEDIATELY (``$TPUDDP_AUTO_RESUME=1``, no backoff — the
+  scheduler already paid the drain latency).
+- ``76``/``77`` and anything else non-zero — restart with **jittered
+  exponential backoff** (the resilience/retry.py discipline: decorrelate N
+  supervisors stampeding a shared rendezvous) and auto-resume from the
+  newest intact checkpoint.
+- repeated ``76`` (peer death keeps recurring — the pod genuinely lost
+  capacity, it is not a transart): **degrade gracefully** instead of dying —
+  shrink the world size by ``shrink_factor`` (``$TPUDDP_WORLD_SIZE``, which
+  both entrypoints honor via ``config.world_size_from``) and resume through
+  the elastic v2 restore path (training/checkpoint.py reshards the
+  checkpoint onto the smaller world).
+
+Every restart is bounded by ``max_restarts``; exhaustion returns the child's
+last exit code so the wrapping scheduler still sees the truth.
+
+``runner`` is injectable (tests drive the policy with a fake child);
+``first_attempt_env`` applies extra env ONLY to attempt 0 and is stripped
+from every restart — the chaos suite injects its ``$TPUDDP_FAULT`` there so
+the fault cannot re-fire in the resumed process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from tpuddp.resilience.preemption import (
+    EXIT_DESYNC,
+    EXIT_PREEMPTED,
+    EXIT_WATCHDOG,
+)
+
+logger = logging.getLogger("tpuddp")
+
+WORLD_ENV = "TPUDDP_WORLD_SIZE"
+_AUTO_RESUME_ENV = "TPUDDP_AUTO_RESUME"
+_SPAWNED_ENV = "TPUDDP_SPAWNED"
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorPolicy:
+    """Restart policy knobs (tools/supervise.py exposes them as flags).
+
+    ``shrink_after`` consecutive watchdog deaths (exit 76) shrink the world
+    by ``shrink_factor`` — but never below ``min_world``; once unshrinkable,
+    peer deaths fall back to plain bounded restarts. ``backoff_base``/
+    ``backoff_cap``/``jitter`` follow the retry.py delay shape."""
+
+    max_restarts: int = 8
+    backoff_base: float = 1.0
+    backoff_cap: float = 60.0
+    jitter: float = 0.5
+    shrink_after: int = 2
+    shrink_factor: int = 2
+    min_world: int = 1
+
+    def __post_init__(self):
+        if self.max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got {self.max_restarts}")
+        if self.shrink_factor < 2:
+            raise ValueError(f"shrink_factor must be >= 2, got {self.shrink_factor}")
+        if not (0.0 <= self.jitter <= 1.0):
+            raise ValueError(f"jitter must be in [0, 1], got {self.jitter}")
+
+    def delay(self, consecutive_failures: int, rng: random.Random) -> float:
+        base = min(
+            self.backoff_cap,
+            self.backoff_base * (2.0 ** max(0, consecutive_failures - 1)),
+        )
+        return base * rng.uniform(1.0 - self.jitter, 1.0 + self.jitter)
+
+
+def _run_subprocess(argv: Sequence[str], env: Dict[str, str]) -> int:
+    return subprocess.call(list(argv), env=env)
+
+
+class RestartSupervisor:
+    """Supervise one training command through the exit-code contract.
+
+    ``world_size=None`` leaves the child's own world-size resolution alone
+    (no elastic shrink possible — the supervisor cannot shrink a world it
+    does not control); an int pins ``$TPUDDP_WORLD_SIZE`` and arms the
+    shrink policy."""
+
+    def __init__(
+        self,
+        argv: Sequence[str],
+        policy: Optional[SupervisorPolicy] = None,
+        world_size: Optional[int] = None,
+        env: Optional[Dict[str, str]] = None,
+        first_attempt_env: Optional[Dict[str, str]] = None,
+        auto_resume_first: bool = False,
+        runner: Optional[Callable[[Sequence[str], Dict[str, str]], int]] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+    ):
+        self.argv = list(argv)
+        self.policy = policy or SupervisorPolicy()
+        self.world_size = int(world_size) if world_size else None
+        self.env = dict(env or {})
+        self.first_attempt_env = dict(first_attempt_env or {})
+        self.auto_resume_first = bool(auto_resume_first)
+        self.runner = runner or _run_subprocess
+        self.sleep = sleep
+        self._rng = rng or random.Random()
+        # (attempt_index, exit_code, world_size) per child run — the
+        # supervisor's own post-mortem trail (tests assert against it)
+        self.history: List[Tuple[int, int, Optional[int]]] = []
+
+    # ------------------------------------------------------------------ env --
+    def _child_env(self, attempt: int) -> Dict[str, str]:
+        env = dict(os.environ)
+        env.update(self.env)
+        # the child must be free to re-exec for ITS world size (a shrunk
+        # world needs a different virtual-device count on the CPU rung)
+        env.pop(_SPAWNED_ENV, None)
+        if attempt == 0:
+            env.update(self.first_attempt_env)
+            if self.auto_resume_first:
+                env[_AUTO_RESUME_ENV] = "1"
+        else:
+            # a restart is ALWAYS a resume — and never re-fires the first
+            # attempt's injected chaos
+            for k in self.first_attempt_env:
+                env.pop(k, None)
+            env[_AUTO_RESUME_ENV] = "1"
+        if self.world_size:
+            env[WORLD_ENV] = str(self.world_size)
+        return env
+
+    # ------------------------------------------------------------------ run --
+    def run(self) -> int:
+        restarts = 0
+        consecutive_failures = 0  # backoff exponent (resets on 75)
+        consecutive_peer_deaths = 0  # shrink trigger (exit-76 streak)
+        attempt = 0
+        while True:
+            rc = self.runner(self.argv, self._child_env(attempt))
+            self.history.append((attempt, rc, self.world_size))
+            attempt += 1
+            if rc == 0:
+                logger.info("supervisor: child finished cleanly")
+                return 0
+            restarts += 1
+            if restarts > self.policy.max_restarts:
+                logger.critical(
+                    "supervisor: restart budget (%d) exhausted; surfacing the "
+                    "child's exit code %d",
+                    self.policy.max_restarts, rc,
+                )
+                return rc
+            if rc == EXIT_PREEMPTED:
+                # clean drain: the emergency checkpoint exists; resume now
+                consecutive_failures = 0
+                consecutive_peer_deaths = 0
+                logger.warning(
+                    "supervisor: child drained after preemption (exit %d); "
+                    "resuming immediately (restart %d/%d)",
+                    rc, restarts, self.policy.max_restarts,
+                )
+                continue
+            consecutive_failures += 1
+            if rc == EXIT_WATCHDOG:
+                consecutive_peer_deaths += 1
+                if (
+                    consecutive_peer_deaths >= self.policy.shrink_after
+                    and self.world_size
+                    and self.world_size // self.policy.shrink_factor
+                    >= max(1, self.policy.min_world)
+                ):
+                    new_world = self.world_size // self.policy.shrink_factor
+                    logger.critical(
+                        "supervisor: %d consecutive peer deaths (exit %d) — "
+                        "the pod lost capacity, not a transient. Shrinking "
+                        "world %d -> %d and resuming through the elastic "
+                        "restore path.",
+                        consecutive_peer_deaths, rc, self.world_size, new_world,
+                    )
+                    self.world_size = new_world
+                    consecutive_peer_deaths = 0
+                    consecutive_failures = 0
+                    continue
+            else:
+                consecutive_peer_deaths = 0
+            delay = self.policy.delay(consecutive_failures, self._rng)
+            logger.warning(
+                "supervisor: child exited %d (%s); restart %d/%d with "
+                "auto-resume in %.1fs",
+                rc,
+                {EXIT_WATCHDOG: "stale peer", EXIT_DESYNC: "replica desync"}.get(
+                    rc, "crash"
+                ),
+                restarts, self.policy.max_restarts, delay,
+            )
+            self.sleep(delay)
+
+
+def supervise(argv: Sequence[str], **kwargs) -> int:
+    """One-call form: ``supervise(cmd, world_size=8, ...) -> exit code``."""
+    return RestartSupervisor(argv, **kwargs).run()

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import threading
 import time
 from typing import Callable, List, Optional, Tuple, Union
@@ -68,6 +69,40 @@ def heartbeat_dir(save_dir: Optional[str]) -> Optional[str]:
 
 def _hb_path(directory: str, process_id: int) -> str:
     return os.path.join(directory, f"hb_{process_id}")
+
+
+_HB_RE = re.compile(r"^hb_(\d+)$")
+
+
+def purge_stale_peers(directory: str, num_processes: int) -> int:
+    """Remove heartbeat files whose ``process_id >= num_processes`` — the
+    droppings of a previous LARGER world in the same ``heartbeat_dir``.
+
+    An elastically-shrunk resume (ISSUE 7) reuses the save_dir, and with it
+    ``<save_dir>/.heartbeats``: the old world's extra ``hb_{i}`` files are
+    forever-stale by definition, and any watchdog that trusted them would
+    kill the healthy smaller run with exit 76. Best-effort (a peer may purge
+    the same file concurrently); returns the number removed."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    removed = 0
+    for name in names:
+        m = _HB_RE.match(name)
+        if m and int(m.group(1)) >= num_processes:
+            try:
+                os.remove(os.path.join(directory, name))
+                removed += 1
+            except OSError:
+                pass  # a peer got there first, or the FS hiccuped
+    if removed:
+        logger.info(
+            "watchdog: purged %d stale heartbeat file(s) from a previous "
+            "larger world in %s (current world: %d processes)",
+            removed, directory, num_processes,
+        )
+    return removed
 
 
 def write_heartbeat(directory: str, process_id: int, now: Optional[float] = None) -> str:
@@ -144,6 +179,11 @@ def start(
             _DIR_ENV,
         )
         return None
+    # a previous (possibly larger) run's leftover heartbeat files must not
+    # poison this run's staleness verdicts: ids past the current world are
+    # purged outright (they would never be rewritten), and check_once treats
+    # beats that predate this watchdog as "no file yet" (startup grace)
+    purge_stale_peers(directory, num_processes)
     hb = Heartbeat(directory, process_id, interval=interval).start()
     # heartbeat-lag telemetry: the stale-peer verdict lands in history.jsonl
     # as a typed event row, written by WHICHEVER process detected it (the
@@ -211,7 +251,11 @@ class Watchdog:
     def check_once(self, now: Optional[float] = None) -> List[Tuple[int, float]]:
         """Stale peers as ``(peer_id, age_seconds)``. A peer with no file yet
         is only stale once the timeout has elapsed since the watchdog started
-        (startup grace — peers finish rendezvous at slightly different times)."""
+        (startup grace — peers finish rendezvous at slightly different times).
+        A file whose beat PREDATES this watchdog is a leftover from a
+        previous run in the same heartbeat_dir (e.g. an elastic resume) and
+        gets the same startup grace — trusting it would kill a healthy
+        resumed run the instant the watchdog armed."""
         now = time.time() if now is None else now
         started = self._started_at if self._started_at is not None else now
         stale = []
@@ -219,6 +263,8 @@ class Watchdog:
             if peer == self.process_id:
                 continue
             beat = read_heartbeat(self.directory, peer)
+            if beat is not None and beat < started:
+                beat = None  # a previous run's droppings: same as no file
             if beat is None:
                 if now - started > self.timeout:
                     stale.append((peer, now - started))

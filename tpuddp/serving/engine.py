@@ -48,12 +48,20 @@ class ServingEngine:
         stats_window: int = 64,
         out_dir: Optional[str] = None,
         config: Optional[dict] = None,
+        unhealthy_after: int = 3,
     ):
+        """``unhealthy_after``: K consecutive dispatch errors mark a replica
+        unhealthy — its loop stops pulling work (a broken device/program no
+        longer fails every batch routed to it) and a ``replica_unhealthy``
+        event row lands in history.jsonl; healthy replicas keep serving and
+        drain still exits cleanly. 0 disables the marking (legacy behavior:
+        each batch on the broken replica fails individually, forever)."""
         self.pool = pool
         self.queue = RequestQueue(max_queue_depth, per_tenant_quota)
         self.scheduler = BatchScheduler(
             self.queue, max_batch_size, batch_timeout_ms
         )
+        self.unhealthy_after = int(unhealthy_after or 0)
         self.writer = MetricsWriter(out_dir) if out_dir else None
         self.stats = ServingStats(self.writer, window=stats_window)
         self._config = dict(config or {})
@@ -78,6 +86,7 @@ class ServingEngine:
             stats_window=int(cfg["stats_window"]),
             out_dir=out_dir,
             config=cfg,
+            unhealthy_after=int(cfg.get("unhealthy_after", 3) or 0),
         )
 
     # ------------------------------------------------------------- lifecycle --
@@ -217,11 +226,25 @@ class ServingEngine:
         """One replica's life: pull, dispatch, deliver, repeat — exits when
         the queue closes and drains. A failed dispatch fails its batch's
         requests (never the loop): clients see the exception through their
-        future, the next batch proceeds."""
+        future, the next batch proceeds. ``unhealthy_after`` consecutive
+        failures mark the replica unhealthy: with healthy peers remaining,
+        this loop simply stops pulling (traffic continues on the peers);
+        when it was the LAST healthy replica, the loop keeps pulling and
+        fails batches immediately so queued clients get errors instead of a
+        hung drain."""
         while True:
             batch = self.scheduler.next_batch()
             if batch is None:
                 return
+            if not replica.healthy:
+                # only reachable when no healthy replica remains (see below)
+                err = RuntimeError(
+                    f"serving: replica {replica.index} is unhealthy and no "
+                    "healthy replicas remain"
+                )
+                for r in batch.requests:
+                    r.result._deliver(None, error=err)
+                continue
             t_dispatch = time.perf_counter()
             try:
                 logits = np.asarray(replica.infer(batch.x))  # fetch = fence
@@ -229,6 +252,7 @@ class ServingEngine:
                 logger.exception(
                     "serving: dispatch failed on replica %d", replica.index
                 )
+                replica.consecutive_errors += 1
                 for r in batch.requests:
                     r.result._deliver(None, error=e)
                 if self.writer is not None:
@@ -243,7 +267,37 @@ class ServingEngine:
                             },
                         )
                     )
+                if (
+                    self.unhealthy_after
+                    and replica.healthy
+                    and replica.consecutive_errors >= self.unhealthy_after
+                ):
+                    replica.healthy = False
+                    logger.critical(
+                        "serving: replica %d marked UNHEALTHY after %d "
+                        "consecutive dispatch errors; routing stops",
+                        replica.index, replica.consecutive_errors,
+                    )
+                    if self.writer is not None:
+                        self.writer.write(
+                            schema.stamp(
+                                "event",
+                                {
+                                    "event": "replica_unhealthy",
+                                    "replica": replica.index,
+                                    "consecutive_errors":
+                                        replica.consecutive_errors,
+                                },
+                            )
+                        )
+                    if any(r.healthy for r in self.pool.replicas):
+                        return  # healthy peers keep serving; stop routing here
+                    logger.critical(
+                        "serving: NO healthy replicas remain; failing queued "
+                        "requests instead of hanging the drain"
+                    )
                 continue
+            replica.consecutive_errors = 0
             t_done = time.perf_counter()
             for r, (lo, hi) in zip(batch.requests, batch.slices):
                 # copy, don't view: a view would pin the whole padded

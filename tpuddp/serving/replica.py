@@ -104,6 +104,12 @@ class Replica:
 
         self._fwd = jax.jit(fwd)
         self.dispatches = 0
+        # graceful degradation (ISSUE 7 satellite): the engine marks a
+        # replica unhealthy after K consecutive dispatch errors and stops
+        # routing to it; healthy peers keep serving. A successful dispatch
+        # resets the streak.
+        self.healthy = True
+        self.consecutive_errors = 0
 
     def infer(self, x) -> jax.Array:
         """Dispatch one padded batch; returns device logits (async — the

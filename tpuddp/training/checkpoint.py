@@ -23,10 +23,35 @@ files with a logged warning instead of crashing the resume path; a small
 (``completed=1`` -> resume at epoch+1) from preemption-drain emergency saves
 (``completed=0`` -> redo the interrupted epoch); ``keep_last`` pruning bounds
 checkpoint disk on long runs.
+
+Elastic resume (ISSUE 7): checkpoints written through ``save_on_main`` carry
+a **format-v2 topology record** — world size, mesh axes/shape, and a per-leaf
+shard tag for every world-size-DEPENDENT leaf (the weight-update-sharded flat
+optimizer vectors, padded to a world multiple, and the bf16_ef per-replica
+error-feedback residual). Replicated leaves are world-independent and carry
+no tag. On ``load``/``restore_latest`` onto a *different* world size M (the
+checkpoint's was N):
+
+- untagged (replicated) leaves load unchanged — the broadcast is implicit;
+- ``data_flat`` leaves (flat vectors zero-padded to a world multiple) are
+  re-padded to the new world's length — exact, because the tail past the raw
+  element count is zeros by construction;
+- ``per_replica`` leaves (the ``(N * per,)`` bf16_ef residual) are
+  redistributed **sum-preservingly** when M | N or N | M
+  (:func:`tpuddp.parallel.comm.redistribute_residual`), and RESET to zero
+  (with a typed ``comm_state_reset`` event handed to the caller's
+  ``reshard_log``) when neither divides — the documented fallback.
+
+Same-topology loads take the identical byte-for-byte path as before (shapes
+match, no reshard). v1 checkpoints (no topology record) keep loading
+unchanged on their original topology; loaded onto a DIFFERENT world size
+their world-dependent leaves mismatch and raise :class:`TopologyMismatch`
+pointing at the v2 elastic path instead of reshaping or mis-slicing.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import re
@@ -41,6 +66,8 @@ from tpuddp.resilience import faults, integrity
 
 logger = logging.getLogger("tpuddp")
 
+FORMAT_VERSION = 2  # v2 = topology record present (elastic resume)
+
 _KEY_MARK = "__prngkey__"
 _BF16_MARK = "__bf16__"  # npz can't serialize ml_dtypes natively (loads back
 # as void16); bf16 leaves — e.g. Adam moments under optimizer_state_dtype —
@@ -48,16 +75,124 @@ _BF16_MARK = "__bf16__"  # npz can't serialize ml_dtypes natively (loads back
 _META_MARK = "__meta__"  # scalar bookkeeping (epoch, completed flag) stored
 # alongside the leaves; load() iterates the template's leaves so meta keys are
 # invisible to it, and read_meta() reads them without needing a template.
+_TOPO_MARK = "__topology__"  # v2: one JSON record (world size, mesh axes, and
+# per-leaf shard tags for world-size-dependent leaves) — the metadata the
+# elastic reshard path needs; invisible to template iteration like the meta.
+
+
+class TopologyMismatch(ValueError):
+    """A checkpoint's world-size-dependent state cannot be fitted onto the
+    current topology: either the file predates the v2 topology record (v1
+    checkpoints have no resharding story) or the elastic reshard lacks the
+    information it needs (e.g. the current world size)."""
 
 
 def _path_str(path) -> str:
     return jax.tree_util.keystr(path)
 
 
-def save(path: str, tree: Any, meta: Optional[Dict[str, int]] = None) -> str:
+# Leaf-path anchors for world-size-dependent state. Anchored to the
+# TrainState fields / managed state-dict entries — a model parameter whose
+# own name merely CONTAINS "comm_state" must not match.
+_COMM_FLAT_KEYS = (".comm_state", "['comm_state']")  # the flat residual vector
+
+
+def _is_opt_state_key(key: str) -> bool:
+    return key.startswith(".opt_state") or key.startswith("['opt_state']")
+
+
+def _is_world_dependent_key(key: str) -> bool:
+    """Could this leaf's shape depend on the world size? (The flat bf16_ef
+    residual and the weight-update-sharded flat optimizer vectors do; params,
+    buffers, counters, and tree-shaped moments never do.)"""
+    return key in _COMM_FLAT_KEYS or _is_opt_state_key(key)
+
+
+def derive_topology(tree: Any, world_size: Optional[int] = None) -> Optional[dict]:
+    """The v2 topology record for ``tree``: world size, mesh axes/shape, and
+    a shard tag per world-size-dependent leaf. Derived from the leaves' live
+    ``NamedSharding``s (the common case: a training state still on the mesh);
+    ``world_size`` overrides/supplies the world when shardings are absent
+    (host-array trees, multi-host states already gathered). Returns None when
+    no world size is derivable — the save then carries no topology record
+    and loads with v1 semantics."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    mesh_axes = mesh_shape = None
+    world = int(world_size) if world_size else None
+
+    def sharding_of(leaf):
+        if isinstance(leaf, jax.Array):
+            sh = getattr(leaf, "sharding", None)
+            if sh is not None and getattr(sh, "mesh", None) is not None:
+                return sh
+        return None
+
+    for _p, leaf in flat:
+        sh = sharding_of(leaf)
+        if sh is not None:
+            try:
+                mesh = sh.mesh
+                mesh_axes = [str(a) for a in mesh.axis_names]
+                mesh_shape = [int(d) for d in np.shape(mesh.devices)]
+                if world is None:
+                    world = int(np.prod(mesh_shape))
+            except Exception:  # AbstractMesh etc.: keep what we have
+                pass
+            break
+    if world is None:
+        return None
+    leaves: Dict[str, dict] = {}
+    for p, leaf in flat:
+        key = _path_str(p)
+        if np.ndim(leaf) != 1:
+            continue
+        sh = sharding_of(leaf)
+        sharded = sh is not None and not sh.is_fully_replicated
+        n = int(np.shape(leaf)[0])
+        if key in _COMM_FLAT_KEYS:
+            if sharded and n % world == 0:
+                # shard_map bf16_ef: (world * per,) per-replica residual,
+                # P("data") — redistributed on a world change
+                leaves[key] = {
+                    "kind": "per_replica", "world": world, "per": n // world,
+                }
+            else:
+                # auto-mode bf16_ef: the replicated (total,) aggregate
+                # residual — world-dependent only through its padding
+                leaves[key] = {"kind": "data_flat"}
+        elif _is_opt_state_key(key) and sharded:
+            # weight-update-sharded flat moment vector: (total,) padded to a
+            # world multiple, sharded over the data axis — re-padded on load
+            leaves[key] = {"kind": "data_flat"}
+    return {
+        "format": FORMAT_VERSION,
+        "world_size": world,
+        "mesh_axes": mesh_axes,
+        "mesh_shape": mesh_shape,
+        "leaves": leaves,
+    }
+
+
+def read_topology(path: str) -> Optional[dict]:
+    """The v2 topology record of a checkpoint (None for v1 files)."""
+    with np.load(path) as data:
+        if _TOPO_MARK not in data.files:
+            return None
+        return json.loads(str(np.asarray(data[_TOPO_MARK]).item()))
+
+
+def save(
+    path: str,
+    tree: Any,
+    meta: Optional[Dict[str, int]] = None,
+    topology: Optional[dict] = None,
+) -> str:
     """Serialize a pytree to ``path`` (.npz). Caller handles rank gating.
     ``meta``: optional dict of int scalars (e.g. epoch, completed) stored as
     ``__meta__*`` entries, readable via :func:`read_meta` without a template.
+    ``topology``: the v2 elastic record (see :func:`derive_topology`) —
+    stored as a ``__topology__`` JSON entry whose presence marks the file
+    format v2; None writes a v1-compatible file (no resharding story).
     A ``.sha256`` manifest sidecar is published after the data file so
     ``latest()`` can verify integrity before trusting a checkpoint."""
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
@@ -71,6 +206,11 @@ def save(path: str, tree: Any, meta: Optional[Dict[str, int]] = None) -> str:
             payload[_BF16_MARK + key] = np.asarray(arr).view(np.uint16)
         else:
             payload[key] = np.asarray(arr)
+    if topology is not None:
+        # the record's presence IS the v2 marker (read_topology returns None
+        # for v1 files); the meta scalars stay exactly the v1 set so
+        # pre-elastic readers of read_meta() see an unchanged contract
+        payload[_TOPO_MARK] = np.asarray(json.dumps(topology))
     for k, v in (meta or {}).items():
         payload[_META_MARK + k] = np.asarray(int(v), dtype=np.int64)
     tmp = path + ".tmp"
@@ -91,42 +231,164 @@ def read_meta(path: str) -> Dict[str, int]:
     return out
 
 
-def _check_leaf(path: str, key: str, stored: np.ndarray, template: Any) -> np.ndarray:
-    """Shape/dtype validation against the template leaf — the analog of
-    torch ``load_state_dict``'s size-mismatch error. A same-layout checkpoint
-    with different widths (e.g. a 12-class head into a 10-class model) must
-    fail loudly here, not train silently with wrong-width logits."""
-    t_shape = tuple(np.shape(template))
+def _check_dtype(path: str, key: str, stored: np.ndarray, template: Any) -> None:
     t_dtype = np.asarray(template).dtype if not hasattr(template, "dtype") else template.dtype
-    if tuple(stored.shape) != t_shape:
-        raise ValueError(
-            f"checkpoint {path}: leaf {key!r} has shape {tuple(stored.shape)} "
-            f"but the model expects {t_shape}"
-        )
     if stored.dtype != t_dtype:
         raise ValueError(
             f"checkpoint {path}: leaf {key!r} has dtype {stored.dtype} but "
             f"the model expects {t_dtype} (if this is optimizer state, check "
             "training.optimizer_state_dtype matches the saved run)"
         )
-    return stored
 
 
-def load(path: str, like: Any) -> Any:
-    """Restore a pytree saved by :func:`save`, using ``like`` for structure.
-    Leaf shapes and dtypes are validated against ``like``; mismatches raise
-    with the offending leaf named."""
+def _refit_flat(path: str, key: str, stored: np.ndarray, t_shape) -> np.ndarray:
+    """Re-pad a flat world-padded vector (WUS moments, the auto-mode bf16_ef
+    residual) to the current world's length. Exact: both lengths are the raw
+    element count padded up to a world multiple, and every element past the
+    raw count is zero by construction — so truncating a longer vector may
+    only drop zeros (verified), and growing one appends zeros."""
+    n_new = int(t_shape[0])
+    n_old = int(stored.shape[0])
+    if n_new < n_old and np.any(stored[n_new:]):
+        raise TopologyMismatch(
+            f"checkpoint {path}: flat leaf {key!r} has {n_old} elements but "
+            f"the current topology expects {n_new}, and the tail past "
+            f"{n_new} is non-zero — this is not world-multiple padding (was "
+            "the model changed, not just the world size?)"
+        )
+    out = np.zeros((n_new,), stored.dtype)
+    out[: min(n_old, n_new)] = stored[: min(n_old, n_new)]
+    return out
+
+
+def _fit_leaf(
+    path: str,
+    key: str,
+    stored: np.ndarray,
+    template: Any,
+    topo: Optional[dict],
+    world_size: Optional[int],
+    actions: Optional[List[dict]],
+) -> np.ndarray:
+    """Shape/dtype validation against the template leaf — the analog of
+    torch ``load_state_dict``'s size-mismatch error — PLUS the elastic
+    reshard path: a v2-tagged world-size-dependent leaf whose shape differs
+    from the template's is re-fitted to the current topology instead of
+    failing. A same-layout checkpoint with different widths (e.g. a 12-class
+    head into a 10-class model) must still fail loudly here, not train
+    silently with wrong-width logits."""
+    t_shape = tuple(np.shape(template))
+    if tuple(stored.shape) == t_shape:
+        _check_dtype(path, key, stored, template)
+        return stored  # same topology: byte-identical fast path
+    info = ((topo or {}).get("leaves") or {}).get(key)
+    if info is None:
+        if topo is None and _is_world_dependent_key(key) and stored.ndim == 1 and len(t_shape) == 1:
+            raise TopologyMismatch(
+                f"checkpoint {path}: world-size-dependent leaf {key!r} has "
+                f"shape {tuple(stored.shape)} but the current topology "
+                f"expects {t_shape}. This checkpoint predates the format-v2 "
+                "topology record and cannot be resharded onto a different "
+                "world size — resume it on the topology that wrote it, or "
+                "re-save it through save_on_main (elastic v2) first."
+            )
+        raise ValueError(
+            f"checkpoint {path}: leaf {key!r} has shape {tuple(stored.shape)} "
+            f"but the model expects {t_shape}"
+        )
+    _check_dtype(path, key, stored, template)
+    from_world = int((topo or {}).get("world_size") or 0) or None
+    if info["kind"] == "data_flat":
+        out = _refit_flat(path, key, stored, t_shape)
+        if actions is not None:
+            actions.append({
+                "leaf": key, "action": "repadded",
+                "from_shape": list(stored.shape), "to_shape": list(t_shape),
+            })
+        return out
+    if info["kind"] == "per_replica":
+        if world_size is None:
+            raise TopologyMismatch(
+                f"checkpoint {path}: per-replica leaf {key!r} (saved on a "
+                f"{info['world']}-replica world) needs the CURRENT world "
+                "size to redistribute; pass world_size= to load/"
+                "restore_latest (the epoch drivers do)"
+            )
+        from tpuddp.parallel.comm import redistribute_residual
+
+        n_from, per_from = int(info["world"]), int(info["per"])
+        if stored.shape[0] != n_from * per_from:
+            raise TopologyMismatch(
+                f"checkpoint {path}: per-replica leaf {key!r} has "
+                f"{stored.shape[0]} elements but its topology record says "
+                f"{n_from} x {per_from}"
+            )
+        if int(t_shape[0]) % int(world_size) != 0:
+            raise TopologyMismatch(
+                f"checkpoint {path}: per-replica leaf {key!r} target length "
+                f"{t_shape[0]} is not a multiple of world_size={world_size}"
+            )
+        per_to = int(t_shape[0]) // int(world_size)
+        mat = stored.reshape(n_from, per_from)
+        # column re-pad first (the per-replica vector is itself world-padded)
+        if per_from != per_to:
+            cols = np.zeros((n_from, per_to), stored.dtype)
+            keep = min(per_from, per_to)
+            if per_from > per_to and np.any(mat[:, per_to:]):
+                raise TopologyMismatch(
+                    f"checkpoint {path}: per-replica leaf {key!r} carries "
+                    f"non-zero data past the current per-replica length "
+                    f"{per_to} — not world-multiple padding"
+                )
+            cols[:, :keep] = mat[:, :keep]
+            mat = cols
+        new_mat, action = redistribute_residual(mat, int(world_size))
+        if actions is not None:
+            actions.append({
+                "leaf": key, "action": action,
+                "from_world": n_from, "to_world": int(world_size),
+            })
+        if action == "reset":
+            logger.warning(
+                "checkpoint %s: per-replica leaf %r cannot be redistributed "
+                "sum-preservingly from world %d to %d (no divisor relation); "
+                "residual RESET to zero",
+                path, key, n_from, world_size,
+            )
+        return new_mat.reshape(-1)
+    raise TopologyMismatch(
+        f"checkpoint {path}: leaf {key!r} has unknown shard tag {info!r}"
+    )
+
+
+def load_with_topology(
+    path: str,
+    like: Any,
+    world_size: Optional[int] = None,
+    reshard_actions: Optional[List[dict]] = None,
+) -> Tuple[Any, Optional[dict]]:
+    """:func:`load` plus the file's parsed topology record (None for v1) —
+    one file open for callers that need both (restore_latest, the managed
+    load_state)."""
     with np.load(path) as data:
         stored = dict(data.items())
+    topo = None
+    if _TOPO_MARK in stored:
+        topo = json.loads(str(np.asarray(stored[_TOPO_MARK]).item()))
     flat, treedef = jax.tree_util.tree_flatten_with_path(like)
     leaves = []
     for p, template in flat:
         key = _path_str(p)
         if key in stored:
-            leaves.append(_check_leaf(path, key, stored[key], template))
+            leaves.append(_fit_leaf(
+                path, key, stored[key], template, topo, world_size,
+                reshard_actions,
+            ))
         elif _BF16_MARK + key in stored:
             arr = stored[_BF16_MARK + key].view(ml_dtypes.bfloat16)
-            leaves.append(_check_leaf(path, key, arr, template))
+            leaves.append(_fit_leaf(
+                path, key, arr, template, topo, world_size, reshard_actions
+            ))
         elif _KEY_MARK + key in stored:
             raw = stored[_KEY_MARK + key]
             if not (
@@ -170,7 +432,72 @@ def load(path: str, like: Any) -> Any:
             leaves.append(template)
         else:
             raise KeyError(f"checkpoint {path} is missing leaf {key!r}")
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+    return jax.tree_util.tree_unflatten(treedef, leaves), topo
+
+
+def load(
+    path: str,
+    like: Any,
+    world_size: Optional[int] = None,
+    reshard_actions: Optional[List[dict]] = None,
+) -> Any:
+    """Restore a pytree saved by :func:`save`, using ``like`` for structure.
+    Leaf shapes and dtypes are validated against ``like``; mismatches raise
+    with the offending leaf named.
+
+    Elastic resume: when the file carries a v2 topology record and a
+    world-size-dependent leaf's shape differs from the template's, the leaf
+    is resharded onto the current topology (see the module doc) instead of
+    failing. ``world_size`` is the CURRENT world (needed to redistribute
+    per-replica leaves); ``reshard_actions`` (a caller-supplied list) is
+    appended with one dict per resharded leaf."""
+    return load_with_topology(path, like, world_size, reshard_actions)[0]
+
+
+def build_reshard_events(
+    path: str,
+    epoch: int,
+    topo: Optional[dict],
+    world_size: Optional[int],
+    actions: List[dict],
+) -> List[dict]:
+    """The typed event dicts an elastic restore should land in
+    history.jsonl: one ``topology_change`` summary (worlds, resharded
+    leaves, what happened to the residual) plus one ``comm_state_reset``
+    per residual that had to reset (M∤N). Empty when the restore was
+    same-topology. ONE implementation for every driver — the native epoch
+    driver, the guard-rollback restore, and the managed load_state all
+    record identically."""
+    from_world = (topo or {}).get("world_size")
+    if not (actions or (from_world and world_size and from_world != world_size)):
+        return []
+    events = [{
+        "event": "topology_change",
+        "from_world": from_world,
+        "to_world": world_size,
+        "checkpoint": os.path.basename(path),
+        "checkpoint_epoch": epoch,
+        "resharded_leaves": [a["leaf"] for a in actions],
+        "residual": next(
+            (a["action"] for a in actions if a.get("from_world")), None
+        ),
+    }]
+    for a in actions:
+        if a.get("action") == "reset":
+            events.append({
+                "event": "comm_state_reset",
+                "leaf": a["leaf"],
+                "from_world": a["from_world"],
+                "to_world": a["to_world"],
+                "reason": "no divisor relation between world sizes; "
+                "error-feedback residual reset to zero",
+            })
+    logger.warning(
+        "elastic resume: checkpoint %s written on world %s restored onto "
+        "world %s (%d leaf/leaves resharded)",
+        path, from_world, world_size, len(actions),
+    )
+    return events
 
 
 def checkpoint_path(save_dir: str, epoch: int, prefix: str = "ckpt") -> str:
@@ -207,6 +534,7 @@ def save_on_main(
     prefix: str = "ckpt",
     completed: bool = True,
     keep_last: Optional[int] = None,
+    world_size: Optional[int] = None,
 ) -> Optional[str]:
     """Process-0-only save + barrier — the reference's writer discipline
     (:217-223), with the cross-host shard gather (a collective) BEFORE the
@@ -215,7 +543,11 @@ def save_on_main(
 
     ``completed=False`` marks a preemption-drain emergency save (resume redoes
     ``epoch`` instead of starting at ``epoch + 1``); ``keep_last=K`` prunes all
-    but the K newest epochs after a successful save."""
+    but the K newest epochs after a successful save. The v2 topology record
+    is derived from the tree's live shardings BEFORE the cross-host gather
+    (which flattens sharded leaves to host arrays); ``world_size`` supplies
+    the world when no sharding is inspectable."""
+    topology = derive_topology(tree, world_size)
     if jax.process_count() > 1:
         tree = _gather_cross_host_shards(tree)
     path = None
@@ -225,6 +557,7 @@ def save_on_main(
             checkpoint_path(save_dir, epoch, prefix),
             tree,
             meta={"epoch": epoch, "completed": int(completed)},
+            topology=topology,
         )
         # chaos hook: corrupt@ckpt_N garbles the just-published file (stale
         # manifest included), which latest() must then detect and skip
@@ -283,17 +616,38 @@ def prune_checkpoints(save_dir: str, keep_last: int, prefix: str = "ckpt") -> in
     return removed
 
 
-def restore_latest(save_dir: str, like: Any, prefix: str = "ckpt") -> Tuple[Any, int]:
+def restore_latest(
+    save_dir: str,
+    like: Any,
+    prefix: str = "ckpt",
+    world_size: Optional[int] = None,
+    reshard_log: Optional[List[dict]] = None,
+) -> Tuple[Any, int]:
     """Load the newest intact checkpoint into ``like``'s structure. Returns
     ``(tree, next_epoch)``; ``(like, 0)`` when none exists. An emergency save
     (``completed=0`` meta, written during a preemption drain) yields its own
     epoch as ``next_epoch`` so the interrupted epoch is redone from the saved
-    mid-epoch state; end-of-epoch saves yield ``epoch + 1``."""
+    mid-epoch state; end-of-epoch saves yield ``epoch + 1``.
+
+    Elastic resume: ``world_size`` is the CURRENT world; a v2 checkpoint
+    written on a different world is resharded onto it (see :func:`load`).
+    ``reshard_log`` (a caller-supplied list) then receives ready-to-write
+    typed event dicts — one ``topology_change`` summary naming the worlds
+    and the resharded leaves, plus one ``comm_state_reset`` per residual
+    that had to reset (M∤N) — so the epoch driver can land them as event
+    rows in history.jsonl."""
     found = latest(save_dir, prefix)
     if found is None:
         return like, 0
     path, epoch = found
-    tree = load(path, like)
+    actions: List[dict] = []
+    tree, topo = load_with_topology(
+        path, like, world_size=world_size, reshard_actions=actions
+    )
+    if reshard_log is not None:
+        reshard_log.extend(
+            build_reshard_events(path, epoch, topo, world_size, actions)
+        )
     meta = read_meta(path)
     if not meta.get("completed", 1):
         logger.warning(

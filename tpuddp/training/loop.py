@@ -296,9 +296,18 @@ def run_training_loop(
                     "the host/device cannot hold it",
                     accum, scan_steps * bnb / 1e6, _STAGE_BYTES_BUDGET // 2**20,
                 )
+    # elastic resume (ISSUE 7): restore_latest reshards a checkpoint written
+    # on a different world size onto THIS mesh (training/checkpoint.py) and
+    # hands back the typed topology-change events, written below once the
+    # history's run_meta header exists
+    reshard_log = []
     if auto_resume or auto_resume_requested():
         if save_dir is not None:
-            state, resumed = ckpt.restore_latest(save_dir, state)
+            state, resumed = ckpt.restore_latest(
+                save_dir, state,
+                world_size=getattr(ddp, "world_size", None),
+                reshard_log=reshard_log,
+            )
             if resumed > start_epoch:
                 start_epoch = resumed
                 if is_main:
@@ -323,27 +332,37 @@ def run_training_loop(
 
     # ---- telemetry (tpuddp.observability): typed run_meta header first,
     # then the per-dispatch step recorder + on-demand profiling triggers.
+    meta_extra = {
+        "api": "native",
+        "scan_steps": scan_steps,
+        "grad_accumulation": accum,
+        "start_epoch": start_epoch,
+        "num_epochs": num_epochs,
+        "step_stats_every": int(step_stats_every or 0),
+        "grad_comm_bytes_per_update": getattr(
+            ddp, "grad_comm_bytes_per_step", None
+        ),
+        "grad_comm_bytes_per_update_f32": getattr(
+            ddp, "grad_comm_bytes_per_step_f32", None
+        ),
+        **(run_meta or {}),
+    }
+    topo_change = next(
+        (ev for ev in reshard_log if ev.get("event") == "topology_change"), None
+    )
+    if topo_change is not None:
+        # the header states the elastic provenance: this run CONTINUES a
+        # trajectory that was training on a different world size
+        meta_extra["resumed_from_world"] = topo_change.get("from_world")
     metrics_writer.write(make_run_meta(
         mesh=getattr(ddp, "mesh", None),
         world_size=getattr(ddp, "world_size", None),
         comm_hook=getattr(ddp, "comm_hook", None),
         guard=guard_cfg,
-        extra={
-            "api": "native",
-            "scan_steps": scan_steps,
-            "grad_accumulation": accum,
-            "start_epoch": start_epoch,
-            "num_epochs": num_epochs,
-            "step_stats_every": int(step_stats_every or 0),
-            "grad_comm_bytes_per_update": getattr(
-                ddp, "grad_comm_bytes_per_step", None
-            ),
-            "grad_comm_bytes_per_update_f32": getattr(
-                ddp, "grad_comm_bytes_per_step_f32", None
-            ),
-            **(run_meta or {}),
-        },
+        extra=meta_extra,
     ))
+    for ev in reshard_log:
+        metrics_writer.write(stamp("event", ev))
     # FLOPs probe for the MFU fields: lower (never compile) the single-step
     # program once, at the first epoch boundary — only when the per-batch
     # step exists (grad accumulation refuses it) and shapes are capturable.
@@ -392,13 +411,20 @@ def run_training_loop(
                 f"last trigger: {reason}. The failure recurs after restoring "
                 "known-good state — a systematic divergence, not a transient."
             )
-        restored, redo_epoch = ckpt.restore_latest(save_dir, cur_state)
+        rb_log = []
+        restored, redo_epoch = ckpt.restore_latest(
+            save_dir, cur_state,
+            world_size=getattr(ddp, "world_size", None),
+            reshard_log=rb_log,
+        )
         metrics_writer.write(stamp("event", {
             "event": "rollback",
             "epoch": epoch,
             "resume_epoch": redo_epoch,
             "reason": reason,
         }))
+        for ev in rb_log:
+            metrics_writer.write(stamp("event", ev))
         if is_main:
             log(
                 f"Guard rollback ({reason}): restored last-good checkpoint, "
@@ -409,17 +435,22 @@ def run_training_loop(
     def can_roll_back() -> bool:
         return save_dir is not None and ckpt.latest(save_dir) is not None
 
-    # ---- nan@step=N chaos hook (resilience/faults.py): wired only while an
-    # un-fired nan fault is armed, so normal runs pay nothing per batch. The
+    # ---- step-site chaos hooks (resilience/faults.py): wired only while an
+    # un-fired step fault is armed, so normal runs pay nothing per batch. The
     # step index is the global train micro-batch count from loop entry.
+    # nan@step=N poisons the batch (the guard-firewall proof);
+    # preempt@step=N / crash@step=N kill the run MID-epoch — the elastic
+    # chaos matrix's resize scenarios (resume redoes the interrupted epoch
+    # from the saved mid-epoch state, possibly on a different world size).
     nan_inject = None
-    if faults.has_nan_fault():
+    if faults.has_step_fault():
         _nan_step = {"i": 0}
 
         def nan_inject(host_batch):
-            out = faults.maybe_corrupt_batch(host_batch, _nan_step["i"])
+            i = _nan_step["i"]
             _nan_step["i"] += 1
-            return out
+            faults.maybe_fire("step", step=i)  # process-level kinds
+            return faults.maybe_corrupt_batch(host_batch, i)
 
     multihost = jax.process_count() > 1
     # single-host: poll the drain flag at every batch-group boundary.
@@ -447,7 +478,10 @@ def run_training_loop(
         would double-apply the whole epoch); only its eval metrics are lost."""
         path = None
         if save_dir is not None:
-            path = ckpt.save_on_main(save_dir, epoch, state, completed=completed)
+            path = ckpt.save_on_main(
+                save_dir, epoch, state, completed=completed,
+                world_size=getattr(ddp, "world_size", None),
+            )
             if is_main:
                 log(f"Preempted: emergency checkpoint for epoch {epoch} saved.")
         # the drain's event row, fsync'd NOW: the SIGKILL that follows the
@@ -692,7 +726,8 @@ def run_training_loop(
                         epoch, epoch_skips, record["skipped_steps"],
                     )
                 ckpt.save_on_main(
-                    save_dir, epoch, state, keep_last=keep_last
+                    save_dir, epoch, state, keep_last=keep_last,
+                    world_size=getattr(ddp, "world_size", None),
                 )
             epoch += 1
     finally:

@@ -220,27 +220,41 @@ def run_training_loop(
         stamp,
         stop_profiler,
     )
+    from tpuddp.resilience import faults
     from tpuddp.resilience import guard as guard_lib
 
     metrics_writer = MetricsWriter(save_dir)
     profiling = maybe_start_profiler(save_dir)
     guard_cfg = guard_lib.resolve_guard(getattr(accelerator, "guard", None))
+    # elastic resume (ISSUE 7): load_state stashed any topology-change events
+    # (the restored state was written on a different world size); the header
+    # names the provenance and the typed event rows land right after it
+    restore_events = list(getattr(accelerator, "last_restore_events", []) or [])
+    meta_extra = {
+        "api": "managed",
+        "fuse_steps": getattr(accelerator, "fuse_steps", None),
+        "grad_accumulation": getattr(
+            accelerator, "gradient_accumulation_steps", 1
+        ),
+        "start_epoch": start_epoch,
+        "num_epochs": num_epochs,
+        "step_stats_every": int(step_stats_every or 0),
+        **(run_meta or {}),
+    }
+    topo_change = next(
+        (ev for ev in restore_events if ev.get("event") == "topology_change"),
+        None,
+    )
+    if topo_change is not None:
+        meta_extra["resumed_from_world"] = topo_change.get("from_world")
     metrics_writer.write(make_run_meta(
         mesh=getattr(accelerator, "mesh", None),
         comm_hook=getattr(accelerator, "comm_hook", None),
         guard=guard_cfg,
-        extra={
-            "api": "managed",
-            "fuse_steps": getattr(accelerator, "fuse_steps", None),
-            "grad_accumulation": getattr(
-                accelerator, "gradient_accumulation_steps", 1
-            ),
-            "start_epoch": start_epoch,
-            "num_epochs": num_epochs,
-            "step_stats_every": int(step_stats_every or 0),
-            **(run_meta or {}),
-        },
+        extra=meta_extra,
     ))
+    for ev in restore_events:
+        metrics_writer.write(stamp("event", ev))
     # managed-path step timing is dispatch-resolution (a mid-epoch device
     # fence would flush the fuse_steps queue and break the fusion it is
     # measuring) — the epoch boundary's loss materialization is the fence
@@ -315,6 +329,11 @@ def run_training_loop(
     try:
         epoch = start_epoch
         while epoch < num_epochs:
+            # $TPUDDP_FAULT chaos hook (native-driver parity): injected
+            # crash/preempt/hang fire at the managed epoch boundary too, so
+            # the elastic chaos matrix can kill the Accelerator entrypoint
+            # at a deterministic point
+            faults.maybe_fire("epoch", epoch=epoch)
             if preemption_requested():
                 drain(epoch - 1)
             if (

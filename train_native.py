@@ -144,15 +144,10 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
     )
 
     # Resume path (the reference only documents loading, README.md:51-52):
-    # training.resume: true restores the newest ckpt_{epoch}.npz in out_dir.
-    start_epoch = 0
-    if training.get("resume"):
-        from tpuddp.training import checkpoint as ckpt
-
-        state, start_epoch = ckpt.restore_latest(save_dir, state)
-        if start_epoch:
-            print(f"Resumed from epoch {start_epoch - 1} checkpoint.")
-
+    # training.resume: true restores the newest ckpt_{epoch}.npz in out_dir —
+    # routed through the epoch driver's auto-resume restore (one restore
+    # implementation), which also reshards elastically onto THIS mesh and
+    # lands the topology-change event rows in history.jsonl.
     run_training_loop(
         ddp,
         state,
@@ -164,13 +159,13 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         set_epoch=optional_args.get("set_epoch", True),
         print_rand=optional_args.get("print_rand", False),
         data_probe_every=100,  # shard-disjointness probe (reference :112-115)
-        start_epoch=start_epoch,
         scan_steps=training.get("scan_steps", "auto"),
         per_replica_log=True,  # reference's per-device loss lines (:186-191)
         # resilience knobs: auto_resume restores the newest INTACT checkpoint
-        # (also forced by $TPUDDP_AUTO_RESUME=1, the scheduler-requeue path);
+        # (training.resume rides the same path; also forced by
+        # $TPUDDP_AUTO_RESUME=1, the scheduler-requeue contract);
         # keep_last bounds checkpoint disk on long runs
-        auto_resume=bool(training.get("auto_resume")),
+        auto_resume=bool(training.get("auto_resume") or training.get("resume")),
         keep_last=(
             int(training["keep_last"]) if training.get("keep_last") else None
         ),
