@@ -160,9 +160,11 @@ def test_gather_single_process(acc):
 
 
 def test_deferred_metrics_matches_eager(cpu_devices):
-    """The opt-in deferred-metrics mode (one epoch-end transfer instead of a
-    per-batch loss.item() sync — quirk Q5 opt-out) must produce numerically
-    identical epoch metrics to the default eager mode."""
+    """Deferred vs eager metric reads must be numerically identical. The
+    train pass always drains losses at epoch end now (the async pipeline
+    retired the per-batch loss.item() sync — quirk Q5); the deferred knob
+    still selects the fused vs facade EVAL path, and fuse_steps the scan
+    batching — neither may change the metrics."""
     import train_accelerate as ta
     from tpuddp.data.transforms import make_eval_transform, make_train_augment
 
@@ -185,7 +187,7 @@ def test_deferred_metrics_matches_eager(cpu_devices):
         eval_tf = jax.jit(make_eval_transform(size=None))
         prepared_loader.set_epoch(0)
         tr, n_tr = ta.train(
-            model, prepared_loader, criterion, opt, accel, augment, deferred=deferred
+            model, prepared_loader, criterion, opt, accel, augment
         )
         te, pct, n_te = ta.evaluate(
             model, test_loader, criterion, accel.device, eval_tf, deferred=deferred

@@ -23,7 +23,14 @@ if a ``bench_results.json`` exists at the repo root, it is validated too. A
 writer drifting off the typed record schema (tpuddp/observability/schema.py)
 fails the gate here instead of corrupting downstream consumers.
 
-Serving gate (last): ``tools/loadgen.py --quick`` stands the continuous-
+Pipeline gate (after the schema gate): a ``pipeline.depth=2`` dryrun and a
+``pipeline: false`` (synchronous) dryrun of the same seed must produce a
+schema-valid history whose ``step_stats`` windows carry the v3 occupancy
+fields (host_stall_ms / inflight_depth / staging_queue_depth), bitwise-equal
+checkpoints leaf for leaf, and byte-identical step HLO — the async pipeline's
+"zero semantic cost" contract, enforced every gate run.
+
+Serving gate (after the pipeline gate): ``tools/loadgen.py --quick`` stands the continuous-
 batching engine up on the CPU mesh (2 replicas, 2 tenants, ~170 requests
 across a closed-loop calibration + 3 offered-load points) and both emitted
 artifacts — the engine's ``history.jsonl`` (run_meta + serving_stats +
@@ -208,6 +215,101 @@ def _elastic_gate(env) -> int:
     return 0
 
 
+def _pipeline_gate(env) -> int:
+    """Async-pipeline leg (ISSUE 8): a depth-2 pipelined dryrun must produce
+    a schema-valid history whose step_stats windows carry the occupancy
+    fields, land bitwise-identical checkpoints to a synchronous (pipeline:
+    false) run of the same seed, and keep the step HLO identical pipeline
+    on/off (the HLO assertion runs as its test, which lowers both programs)."""
+    import json
+
+    import numpy as np
+
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    worker = os.path.join(REPO, "tests", "_chaos_train_worker.py")
+    with tempfile.TemporaryDirectory(prefix="tpuddp_pipe_gate_") as tmp:
+        base_env = dict(env)
+        base_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        dirs = {}
+        for mode, pipe_cfg in (("on", '{"depth": 2}'), ("off", "false")):
+            out_dir = os.path.join(tmp, mode)
+            os.makedirs(out_dir)
+            dirs[mode] = out_dir
+            worker_env = dict(base_env)
+            worker_env["TPUDDP_CHAOS_TRAINING"] = (
+                '{"step_stats_every": 4, "pipeline": %s}' % pipe_cfg
+            )
+            rc = subprocess.call(
+                [sys.executable, "-u", worker, out_dir, "2"],
+                cwd=REPO, env=worker_env,
+            )
+            if rc != 0:
+                print(f"pipeline gate: {mode} dryrun exited {rc}",
+                      file=sys.stderr)
+                return rc or 1
+        history = os.path.join(dirs["on"], "history.jsonl")
+        rc = subprocess.call(
+            [sys.executable, inspect, "--validate", history],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("pipeline gate: pipelined history.jsonl failed validation",
+                  file=sys.stderr)
+            return rc
+        with open(history) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        windows = [r for r in records if r.get("type") == "step_stats"]
+        if not windows or any(
+            k not in w
+            for w in windows
+            for k in ("host_stall_ms", "inflight_depth", "staging_queue_depth")
+        ):
+            print("pipeline gate: step_stats windows missing the occupancy "
+                  "fields", file=sys.stderr)
+            return 1
+        # bitwise parity: the pipelined run's checkpoints must equal the
+        # synchronous run's, leaf for leaf (params, moments, counters — the
+        # whole TrainState lands in ckpt_{epoch}.npz)
+        for fname in ("ckpt_0.npz", "ckpt_1.npz"):
+            a = np.load(os.path.join(dirs["on"], fname), allow_pickle=False)
+            b = np.load(os.path.join(dirs["off"], fname), allow_pickle=False)
+            if sorted(a.files) != sorted(b.files):
+                print(f"pipeline gate: {fname} key sets differ",
+                      file=sys.stderr)
+                return 1
+            for k in a.files:
+                if a[k].dtype.kind in "SU" or b[k].dtype.kind in "SU":
+                    ok = bool(np.array_equal(a[k], b[k]))
+                else:
+                    ok = a[k].tobytes() == b[k].tobytes()
+                if not ok:
+                    print(
+                        f"pipeline gate: {fname} leaf {k!r} differs between "
+                        "pipelined and synchronous runs", file=sys.stderr,
+                    )
+                    return 1
+        # HLO identity pipeline-on/off: the dedicated test lowers the step
+        # program under both configs and compares the text byte for byte.
+        # Plain env: tests/conftest.py owns its own 8-device XLA_FLAGS and
+        # refuses a world pre-pinned to the gate's 4.
+        rc = subprocess.call(
+            [
+                sys.executable, "-m", "pytest", "-q",
+                "tests/test_pipeline.py", "-k", "hlo_identity",
+                "-p", "no:cacheprovider",
+            ],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("pipeline gate: HLO identity test failed", file=sys.stderr)
+            return rc
+    return 0
+
+
 def main(argv=None):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")  # the full gate never needs a real TPU
@@ -221,6 +323,9 @@ def main(argv=None):
     if rc != 0:
         return rc
     rc = _schema_gate(env)
+    if rc != 0:
+        return rc
+    rc = _pipeline_gate(env)
     if rc != 0:
         return rc
     rc = _serving_gate(env)

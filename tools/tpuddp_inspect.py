@@ -146,9 +146,25 @@ def summarize_history(path: str) -> None:
             "p50ms", "p95ms", "p99ms", "mfu50", "skip",
         ])
         if steps:
-            print(f"\nstep_stats windows: {len(steps)} "
-                  f"(finest p99 {max(s.get('step_time_ms_p99') or 0 for s in steps):.2f} ms, "
-                  f"window size {steps[0].get('steps')})")
+            line = (f"\nstep_stats windows: {len(steps)} "
+                    f"(finest p99 {max(s.get('step_time_ms_p99') or 0 for s in steps):.2f} ms, "
+                    f"window size {steps[0].get('steps')})")
+            # pipeline occupancy (schema v3): total host stall across windows
+            # plus the deepest staged/in-flight queues any window saw
+            stalls = [s.get("host_stall_ms") for s in steps]
+            if any(v is not None for v in stalls):
+                total_stall = sum(v or 0 for v in stalls)
+                line += (
+                    f"\npipeline occupancy: host stall {total_stall:.1f} ms total "
+                    f"(worst window {max(v or 0 for v in stalls):.1f} ms), "
+                    f"staging depth <= {max(s.get('staging_queue_depth') or 0 for s in steps)}, "
+                    f"in-flight <= {max(s.get('inflight_depth') or 0 for s in steps)}"
+                )
+            print(line)
+        host_stall_epoch = [e.get("host_stall_ms") for e in epochs]
+        if any(v for v in host_stall_epoch):
+            print(f"host stall per epoch (ms): "
+                  f"{[round(v, 1) for v in host_stall_epoch if v is not None]}")
 
     if serving:
         print(f"\nserving_stats windows ({len(serving)}):")
@@ -242,8 +258,16 @@ def summarize_bench(path: str) -> None:
             _fmt(r.get("ms_per_step_p50"), 2),
             _fmt(r.get("ms_per_step_p99"), 2),
             _fmt(r.get("mfu")),
+            # async-pipeline columns (every row since r6): wall/device ratio
+            # and host-stall percentiles — '-' on rows predating them
+            _fmt(r.get("wall_to_device_ratio"), 2),
+            _fmt(r.get("host_stall_ms_p50"), 2),
+            _fmt(r.get("host_stall_ms_p95"), 2),
         ])
-    _print_table(rows, ["config", "sps/chip", "ms", "p50ms", "p99ms", "mfu"])
+    _print_table(rows, [
+        "config", "sps/chip", "ms", "p50ms", "p99ms", "mfu",
+        "w/dev", "stall50", "stall95",
+    ])
 
 
 def main(argv=None) -> int:

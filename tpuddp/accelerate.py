@@ -239,10 +239,18 @@ class FusedEvaluator:
     """
 
     def __init__(self, model: "PreparedModel", criterion, transform=None,
-                 fuse_steps=None):
+                 fuse_steps=None, stage_uploads: bool = True):
         self.model = model
         self.criterion = criterion
         self.transform = transform
+        # async-pipeline eval staging: each add()'d batch's host->device
+        # transfer is issued IMMEDIATELY (device_put is async), so chunk
+        # N+1's upload overlaps chunk N's scan dispatch instead of paying
+        # K serial transfers at flush time. Single-process only: the
+        # multi-host flush replicates process-local HOST data. Values and
+        # order are unchanged — bitwise-identical metrics, ragged tails
+        # included (tests/test_pipeline.py).
+        self.stage_uploads = bool(stage_uploads) and jax.process_count() == 1
         # None = resolved at first use (flat 32, capped by the staging
         # budget over the batch bytes — the same policy as the train-side
         # fuse auto; see _resolve_auto_fuse)
@@ -288,6 +296,10 @@ class FusedEvaluator:
         shape_key = batching.shape_key(x)
         if self._queue and self._queue[0][0] != shape_key:
             self._flush()  # ragged stream: never stack mixed shapes
+        if self.stage_uploads:
+            # issue this batch's upload now, overlapping the previous
+            # flush's in-flight dispatch (no-op for already-device arrays)
+            x, y, w = jnp.asarray(x), jnp.asarray(y), jnp.asarray(w)
         self._queue.append((shape_key, x, y, w))
         if len(self._queue) >= self._resolve_fuse():
             self._flush()
@@ -468,6 +480,22 @@ def _resolve_auto_fuse(params, batch_nbytes=None) -> int:
     return batching.resolve_fuse(batch_nbytes, cap=32)
 
 
+# fold_in tag deriving the in-step augmentation key from the step's base rng:
+# the dropout stream (Context rng) stays byte-identical whether augment is
+# folded into the step or not
+_AUG_FOLD = 0x617567  # "aug"
+
+
+def _apply_step_augment(aug, rng, x):
+    """On-device augmentation inside the compiled train step (the async
+    pipeline's 'host workers only decode and stack' contract for the managed
+    path): keyed off a fold of the step rng so the flip decisions are
+    per-step deterministic and the model's own rng stream is untouched."""
+    if aug is None:
+        return x
+    return aug(jax.random.fold_in(rng, _AUG_FOLD), x)
+
+
 class _LostState:
     """Sentinel for model variables whose device buffers were donated to a
     fused dispatch that then failed — any read must fail loudly."""
@@ -567,6 +595,16 @@ class PreparedModel:
             sample = jax.ShapeDtypeStruct(
                 (1,) + tuple(np.shape(x))[1:], jnp.asarray(x[:1]).dtype
             )
+            aug = getattr(self.accelerator, "augment", None)
+            if aug is not None:
+                # in-step augmentation: the module sees the POST-augment
+                # shape/dtype (e.g. uint8 32x32 decoded batches resized to
+                # the compute dtype at 224) — derive it abstractly, nothing
+                # executes
+                sample = jax.eval_shape(
+                    lambda v: aug(jax.random.key(0), v), sample
+                )
+                sample = jax.ShapeDtypeStruct(sample.shape, sample.dtype)
             params, mstate = self.module.init(key, sample)
         params, mstate = col.broadcast_one_to_all((params, mstate))
         self.params, self.model_state = replicate(
@@ -646,8 +684,11 @@ class PreparedModel:
 
     def _get_grad_step(self, criterion):
         if self._grad_step is None or self._grad_step[0] is not criterion:
+            aug = getattr(self.accelerator, "augment", None)
+
             def grad_step(params, mstate, base_rng, step_idx, x, y, w):
                 rng = jax.random.fold_in(base_rng, step_idx)
+                x = _apply_step_augment(aug, rng, x)
 
                 def loss_fn(p):
                     # sample_weight masks padded rows out of BatchNorm
@@ -730,12 +771,14 @@ class PreparedModel:
         if self._fused_step is None or self._fused_step[0] != key:
             hook = self._comm_hook_name()
             guard_on = self._guard_enabled()
+            aug = getattr(self.accelerator, "augment", None)
 
             def fused(
                 params, mstate, opt_state, comm_state, skipped, base_rng,
                 step_idx, x, y, w,
             ):
                 rng = jax.random.fold_in(base_rng, step_idx)
+                x = _apply_step_augment(aug, rng, x)
 
                 def loss_fn(p):
                     # sample_weight masks padded rows out of BatchNorm
@@ -792,6 +835,7 @@ class PreparedModel:
         if key not in self._fused_scans:
             hook = self._comm_hook_name()
             guard_on = self._guard_enabled()
+            aug = getattr(self.accelerator, "augment", None)
 
             def fused_scan(
                 params, mstate, opt_state, comm_state, skipped, base_rng,
@@ -808,6 +852,7 @@ class PreparedModel:
                     p, ms, os_, cs, sk = carry
                     idx, x, y, w = inp
                     rng = jax.random.fold_in(base_rng, idx)
+                    x = _apply_step_augment(aug, rng, x)
 
                     def loss_fn(pp):
                         ctx = Context(
@@ -1239,6 +1284,7 @@ class Accelerator:
         comm_hook: str = "none",
         bucket_cap_mb: float = comm_lib.DEFAULT_BUCKET_CAP_MB,
         guard=None,
+        augment=None,
     ):
         """``fuse_steps``: K > 1 batches per-step calls into one compiled
         lax.scan dispatch (the managed analog of the native scan fusion) —
@@ -1284,7 +1330,19 @@ class Accelerator:
         optimizer's skip counters (``PreparedOptimizer.skip_counters()``,
         round-tripped by save_state/load_state), and ``prepare`` audits
         every replica's parameter copy. Off by default — identical
-        programs."""
+        programs.
+
+        ``augment``: on-device train augmentation ``(rng, x) -> x`` folded
+        INTO the compiled step programs (the async pipeline's managed-path
+        analog of the native ``DistributedDataParallel(augment=...)``):
+        ``model(raw_inputs)`` then takes decoded uint8 batches and the
+        normalize/flip/resize runs inside the same dispatch as forward+
+        backward+update — one dispatch per step, host workers only decode
+        and stack. The augment key derives from the step rng by a constant
+        fold (``_AUG_FOLD``), so the model's own rng stream (dropout) is
+        unchanged by folding. Train-grad programs only; eval paths keep
+        their explicit transform. None (default): inputs are used as
+        given — the legacy separate-augment cadence."""
         self.mesh = mesh if mesh is not None else data_mesh(num_chips)
         key, _ = seeding.set_seed_based_on_rank(base_seed=seed)
         self._key = key
@@ -1307,6 +1365,7 @@ class Accelerator:
         self.weight_update_sharding = bool(weight_update_sharding)
         self.comm_hook = comm_lib.validate_hook(comm_hook)
         self.guard = guard_lib.resolve_guard(guard)
+        self.augment = augment
         # typed event dicts from the last load_state's elastic reshard (a
         # topology_change when the restored state was written on a different
         # world size); the managed entrypoint lands them in history.jsonl

@@ -55,6 +55,11 @@ TRAINING_DEFAULTS = {
     "bucket_cap_mb": 25,  # comm-hook bucket size cap (torch's bucket_cap_mb):
     # small tensors coalesce into one collective per <= cap-sized bucket
     "prefetch": True,  # background-thread host batch prefetch
+    "pipeline": None,  # async pipeline block (training/pipeline.py): None/
+    # true -> overlapped defaults {depth: 2, host_workers: 2, device_augment:
+    # true, sync_readback: false}; false -> the synchronous A/B reference
+    # (no lookahead, blocking readback per dispatch); a dict overrides the
+    # defaults with unknown-key refusal. Bitwise-identical at every depth.
     "deferred_metrics": False,  # managed path: epoch-end (not per-batch) metric sync
     "fuse_steps": "auto",  # managed path: K step()s per dispatch (auto, with
     # deferred_metrics: 32, capped by a ~256MB queued-batch staging budget)

@@ -142,12 +142,27 @@ class DataLoader:
             return n // self.batch_size
         return math.ceil(n / self.batch_size)
 
-    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    def make_batch_plan(self):
+        """Freeze this epoch's order and return ``(n_batches, fetch)`` where
+        ``fetch(s)`` assembles batch ``s`` independently of any other batch —
+        the random-access protocol PrefetchLoader's worker pool parallelizes
+        over. One plan per epoch; ``__iter__`` is defined in terms of it so
+        the two can never drift."""
         indices = self._indices()
         steps = len(self)
+        batch_size = self.batch_size
+        dataset = self.dataset
+
+        def fetch(s: int):
+            chunk = indices[s * batch_size : (s + 1) * batch_size]
+            return _fetch_padded(dataset, chunk, batch_size)
+
+        return steps, fetch
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        steps, fetch = self.make_batch_plan()
         for s in range(steps):
-            chunk = indices[s * self.batch_size : (s + 1) * self.batch_size]
-            yield _fetch_padded(self.dataset, chunk, self.batch_size)
+            yield fetch(s)
 
 
 class _EpochMemoizedOrder:
@@ -275,18 +290,32 @@ class ShardedDataLoader:
             return n // self.batch_size
         return math.ceil(n / self.batch_size)
 
-    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    def make_batch_plan(self):
+        """Freeze this epoch's per-replica orders and return
+        ``(n_batches, fetch)`` — the random-access protocol PrefetchLoader's
+        worker pool parallelizes over (see :meth:`DataLoader.make_batch_plan`).
+        """
         per_replica = [s.local_indices() for s in self.samplers]
         steps = len(self)
-        for s in range(steps):
+        batch_size = self.batch_size
+        dataset = self.dataset
+
+        def fetch(s: int):
             xs, ys, ws = [], [], []
             for shard in per_replica:
-                chunk = shard[s * self.batch_size : (s + 1) * self.batch_size]
-                x, y, w = _fetch_padded(self.dataset, chunk, self.batch_size)
+                chunk = shard[s * batch_size : (s + 1) * batch_size]
+                x, y, w = _fetch_padded(dataset, chunk, batch_size)
                 xs.append(x)
                 ys.append(y)
                 ws.append(w)
-            yield np.concatenate(xs), np.concatenate(ys), np.concatenate(ws)
+            return np.concatenate(xs), np.concatenate(ys), np.concatenate(ws)
+
+        return steps, fetch
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        steps, fetch = self.make_batch_plan()
+        for s in range(steps):
+            yield fetch(s)
 
     def probe_fingerprint(self, x_local: np.ndarray) -> str:
         """Shard-disjointness probe string: a few raw input values per local
@@ -302,18 +331,37 @@ class ShardedDataLoader:
 
 
 class PrefetchLoader:
-    """Background-thread prefetch over any loader (the tpuddp analog of the
+    """Background-worker prefetch over any loader (the tpuddp analog of the
     reference's ``num_workers=2`` DataLoader workers,
     multi-GPU-training-torch.py:90-98): batch assembly (sampler slicing,
     native gather, padding) overlaps with device compute through a bounded
     queue. Semantics are unchanged — same batches, same order.
+
+    ``workers > 1`` parallelizes batch *assembly* across a thread pool when
+    the inner loader exposes the random-access ``make_batch_plan`` protocol
+    (both tpuddp loaders do); batches are re-emitted strictly in order, so
+    the stream is bitwise-identical to the serial one. Loaders without the
+    protocol fall back to one producer thread.
+
+    Hardening contract (the async-pipeline satellite):
+
+    - a worker exception propagates to the consumer with its ORIGINAL
+      traceback attached (the producer frame is visible in the report);
+    - every worker is reaped when iteration ends — normally, by an
+      exception, or by the consumer abandoning the iterator mid-epoch (a
+      preemption drain): the bounded queue can never wedge a producer and
+      leak its thread;
+    - the queue depth is byte-capped against the shared staging budget
+      (``tpuddp/utils/batching.py``) via the loader's ``batch_nbytes``, so
+      prefetch depth x batch bytes stays bounded host memory.
     """
 
     _SENTINEL = object()
 
-    def __init__(self, loader, depth: int = 2):
+    def __init__(self, loader, depth: int = 2, workers: int = 1):
         self.loader = loader
-        self.depth = depth
+        self.depth = max(1, int(depth))
+        self.workers = max(1, int(workers))
 
     # -- delegation so the epoch driver can't tell the difference --
     def set_epoch(self, epoch: int) -> None:
@@ -329,20 +377,51 @@ class PrefetchLoader:
     def __getattr__(self, name):
         return getattr(self.loader, name)
 
+    def effective_depth(self) -> int:
+        """The byte-capped queue depth: ``depth``, bounded by the staging
+        budget over one batch's bytes when they are knowable (the shared
+        depth policy, ``tpuddp/utils/batching.py::resolve_fuse``)."""
+        return batching.resolve_fuse(
+            getattr(self.loader, "batch_nbytes", None), cap=self.depth
+        )
+
     def __iter__(self):
-        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        depth = self.effective_depth()
+        if self.workers > 1 and hasattr(self.loader, "make_batch_plan"):
+            return self._iter_pool(depth)
+        return self._iter_serial(depth)
+
+    def _iter_serial(self, depth: int):
+        """One producer thread driving the inner loader's own iterator."""
+        q: "queue.Queue" = queue.Queue(maxsize=depth)
+        stop = threading.Event()
         err = []
+
+        def _put(item) -> bool:
+            # bounded put that can always be cancelled: a consumer that
+            # abandoned the iterator must be able to reap this thread even
+            # with the queue full
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def produce():
             try:
                 for batch in self.loader:
-                    q.put(batch)
+                    if not _put(batch):
+                        return
             except BaseException as e:  # propagate into the consumer
                 err.append(e)
             finally:
-                q.put(self._SENTINEL)
+                _put(self._SENTINEL)
 
-        thread = threading.Thread(target=produce, daemon=True)
+        thread = threading.Thread(
+            target=produce, daemon=True, name="tpuddp-prefetch"
+        )
         thread.start()
         try:
             while True:
@@ -351,6 +430,79 @@ class PrefetchLoader:
                     break
                 yield item
             if err:
+                # the exception object still carries the producer-side
+                # traceback; re-raising it surfaces the original frames
                 raise err[0]
         finally:
+            stop.set()
+            try:  # unblock a producer stuck on a full queue
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
             thread.join(timeout=5)
+
+    def _iter_pool(self, depth: int):
+        """Worker pool over the inner loader's random-access batch plan;
+        batches re-emit strictly in order."""
+        steps, fetch = self.loader.make_batch_plan()
+        lock = threading.Condition()
+        results = {}  # batch index -> assembled batch (bounded by depth)
+        cursor = {"claim": 0, "emit": 0}
+        stop = threading.Event()
+        err = []
+
+        def work():
+            while not stop.is_set():
+                with lock:
+                    # claim the next batch index, but never run more than
+                    # `depth` batches ahead of the consumer (bounded memory)
+                    while (
+                        not stop.is_set()
+                        and cursor["claim"] < steps
+                        and cursor["claim"] - cursor["emit"] >= depth
+                    ):
+                        lock.wait(0.05)
+                    if stop.is_set() or cursor["claim"] >= steps:
+                        return
+                    s = cursor["claim"]
+                    cursor["claim"] += 1
+                try:
+                    batch = fetch(s)
+                except BaseException as e:
+                    with lock:
+                        err.append(e)
+                        stop.set()
+                        lock.notify_all()
+                    return
+                with lock:
+                    results[s] = batch
+                    lock.notify_all()
+
+        threads = [
+            threading.Thread(
+                target=work, daemon=True, name=f"tpuddp-prefetch-{i}"
+            )
+            for i in range(min(self.workers, max(1, steps)))
+        ]
+        for t in threads:
+            t.start()
+        try:
+            for s in range(steps):
+                with lock:
+                    while s not in results and not err:
+                        lock.wait(0.05)
+                        if err:
+                            break
+                    if err:
+                        raise err[0]
+                    batch = results.pop(s)
+                    cursor["emit"] = s + 1
+                    lock.notify_all()
+                yield batch
+        finally:
+            stop.set()
+            with lock:
+                lock.notify_all()
+            for t in threads:
+                t.join(timeout=5)

@@ -156,6 +156,12 @@ class StepStatsRecorder:
         self._epoch_samples = 0
         self._epoch_t0: Optional[float] = None
         self._last_t: Optional[float] = None
+        # pipeline-occupancy accounting (tpuddp/training/pipeline.py): host
+        # stall accumulates per epoch/window; queue depths keep the window max
+        self._epoch_stall = 0.0
+        self._win_stall = 0.0
+        self._win_staging_max = 0
+        self._win_inflight_max = 0
         # window accounting
         self._win_start_n = 0
         self._win_start_step = 0
@@ -171,15 +177,26 @@ class StepStatsRecorder:
         self._epoch_samples = 0
         self._epoch_t0 = now
         self._last_t = now
+        self._epoch_stall = 0.0
+        self._win_stall = 0.0
+        self._win_staging_max = 0
+        self._win_inflight_max = 0
         self._win_start_n = self._n
         self._win_start_step = self.global_step
         self._win_samples = 0
         self._win_t0 = now
 
-    def record(self, n_steps: int, n_samples: int, fence=None) -> None:
+    def record(
+        self, n_steps: int, n_samples: int, fence=None, *,
+        host_stall_s: float = 0.0, staging_depth: int = 0,
+        inflight_depth: int = 0,
+    ) -> None:
         """One dispatch of ``n_steps`` fused steps covering ``n_samples``
         global samples. ``fence`` is the dispatch's output (any pytree of
-        device arrays); it is blocked on ONLY at a window boundary."""
+        device arrays); it is blocked on ONLY at a window boundary.
+        ``host_stall_s``/``staging_depth``/``inflight_depth`` are the async
+        pipeline's occupancy sample for this dispatch (host-blocked seconds
+        since the previous one; staged-chunk / in-flight queue lengths)."""
         now = time.perf_counter()
         if self._last_t is None:  # record() without start_epoch: self-arm
             self.start_epoch(self._epoch)
@@ -193,6 +210,10 @@ class StepStatsRecorder:
         self.global_step += n_steps
         self._epoch_samples += int(n_samples)
         self._win_samples += int(n_samples)
+        self._epoch_stall += float(host_stall_s)
+        self._win_stall += float(host_stall_s)
+        self._win_staging_max = max(self._win_staging_max, int(staging_depth))
+        self._win_inflight_max = max(self._win_inflight_max, int(inflight_depth))
         self._last_t = now
         if self.window and (self._n - self._win_start_n) >= self.window:
             self._emit_window(fence)
@@ -223,12 +244,22 @@ class StepStatsRecorder:
             "steps": int(self._n - self._win_start_n),
             **step_time_fields(times, self.flops_per_step, self.peak_flops),
             "samples_per_sec": round(self._win_samples / max(wall, 1e-9), 2),
+            # pipeline occupancy (schema v3): how much of this window's wall
+            # the dispatch loop spent blocked on host data, and how deep the
+            # staged/in-flight queues ran — wall/device -> 1.0 is observable
+            # per window, not just per run
+            "host_stall_ms": round(self._win_stall * 1e3, 3),
+            "staging_queue_depth": int(self._win_staging_max),
+            "inflight_depth": int(self._win_inflight_max),
         }
         if self.writer is not None:
             self.writer.write(schema.stamp("step_stats", record))
         self._win_start_n = self._n
         self._win_start_step = self.global_step
         self._win_samples = 0
+        self._win_stall = 0.0
+        self._win_staging_max = 0
+        self._win_inflight_max = 0
         self._win_t0 = self._last_t
 
     def epoch_summary(self) -> dict:
@@ -250,6 +281,9 @@ class StepStatsRecorder:
             "train_samples_per_sec": round(
                 self._epoch_samples / max(wall, 1e-9), 2
             ),
+            # whole-epoch host-stall total (the pipeline's residual host
+            # bound; 0.0 when nothing stalled or no pipeline ran)
+            "host_stall_ms": round(self._epoch_stall * 1e3, 3),
         }
         if steps > self.capacity:
             fields["step_stats_truncated"] = int(steps - self.capacity)

@@ -23,8 +23,12 @@ a gate instead of corrupting downstream consumers. The validators live here
 (not in the tool) so writer tests and the CLI share one definition.
 
 Version history: v1 introduced the envelope and the four training record
-types; v2 added ``serving_stats``. Readers accept every version up to their
-own ``SCHEMA_VERSION`` and reject newer files.
+types; v2 added ``serving_stats``; v3 added the async-pipeline occupancy
+fields to ``step_stats`` (``host_stall_ms``, ``inflight_depth``,
+``staging_queue_depth`` — tpuddp/training/pipeline.py). Readers accept every
+version up to their own ``SCHEMA_VERSION`` and reject newer files; the
+per-version required-field sets apply at the version each record CARRIES, so
+a v2 history (no occupancy fields) stays valid under a v3 reader.
 """
 
 from __future__ import annotations
@@ -34,7 +38,7 @@ import hashlib
 import json
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 RECORD_TYPES = ("run_meta", "epoch", "step_stats", "event", "serving_stats")
 
@@ -93,6 +97,16 @@ _REQUIRED = {
     ),
 }
 
+# Fields additionally required of records stamped at schema_version >= 3:
+# the async pipeline's occupancy accounting. Applied at the version a record
+# CARRIES (older histories keep validating under newer readers).
+_REQUIRED_SINCE_V3 = {
+    "step_stats": (
+        "host_stall_ms",
+        "inflight_depth",
+        "staging_queue_depth",
+    ),
+}
 
 def stamp(record_type: str, record: dict) -> dict:
     """Return ``record`` wrapped in the schema envelope (type first, so the
@@ -184,7 +198,10 @@ def validate_record(record, index: int = 0) -> List[str]:
             f"{where}: schema_version {version} is newer than this reader's "
             f"{SCHEMA_VERSION}"
         )
-    missing = [k for k in _REQUIRED[rtype] if k not in record]
+    required = list(_REQUIRED[rtype])
+    if isinstance(version, int) and version >= 3:
+        required += list(_REQUIRED_SINCE_V3.get(rtype, ()))
+    missing = [k for k in required if k not in record]
     if missing:
         errors.append(f"{where} ({rtype}): missing required field(s) {missing}")
     if rtype == "event" and not isinstance(record.get("event"), str):

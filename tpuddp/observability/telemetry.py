@@ -33,7 +33,7 @@ class _NullTelemetry:
     def pre_dispatch(self, n_steps: int) -> None:
         pass
 
-    def post_dispatch(self, n_steps: int, n_samples: int, fence=None) -> None:
+    def post_dispatch(self, n_steps: int, n_samples: int, fence=None, **occ) -> None:
         pass
 
     def start_epoch(self, epoch: int) -> None:
@@ -105,9 +105,21 @@ class RunTelemetry:
     def pre_dispatch(self, n_steps: int) -> None:
         self.window_profiler.before_dispatch(self.recorder.global_step, n_steps)
 
-    def post_dispatch(self, n_steps: int, n_samples: int, fence=None) -> None:
+    def post_dispatch(
+        self, n_steps: int, n_samples: int, fence=None, *,
+        host_stall_s: float = 0.0, staging_depth: int = 0,
+        inflight_depth: int = 0,
+    ) -> None:
+        """``host_stall_s``/``staging_depth``/``inflight_depth``: the async
+        pipeline's occupancy sample for this dispatch (time the dispatch loop
+        spent blocked acquiring host batches since the previous dispatch, the
+        staged-chunk queue depth, and issued-but-unobserved dispatches) —
+        surfaced in step_stats windows and the epoch summary."""
         self._last_fence = fence
-        self.recorder.record(n_steps, n_samples, fence=fence)
+        self.recorder.record(
+            n_steps, n_samples, fence=fence, host_stall_s=host_stall_s,
+            staging_depth=staging_depth, inflight_depth=inflight_depth,
+        )
         self.window_profiler.after_dispatch(self.recorder.global_step, fence)
 
     # -- epoch boundaries --------------------------------------------------

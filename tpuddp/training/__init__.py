@@ -1,7 +1,12 @@
-"""Training layer: TrainState, compiled DP steps, epoch driver, checkpointing."""
+"""Training layer: TrainState, compiled DP steps, epoch driver, async
+pipeline, checkpointing."""
 
 from tpuddp.training.train_state import TrainState, create_train_state  # noqa: F401
 from tpuddp.training.loop import run_training_loop  # noqa: F401
+from tpuddp.training.pipeline import PipelineConfig, resolve_pipeline  # noqa: F401
 from tpuddp.training import checkpoint  # noqa: F401
 
-__all__ = ["TrainState", "create_train_state", "run_training_loop", "checkpoint"]
+__all__ = [
+    "TrainState", "create_train_state", "run_training_loop", "checkpoint",
+    "PipelineConfig", "resolve_pipeline",
+]

@@ -43,10 +43,10 @@ from tpuddp.observability import (
     stamp,
     stop_profiler,
 )
-from tpuddp.observability import telemetry as telemetry_lib
 from tpuddp.training import checkpoint as ckpt
+from tpuddp.training import pipeline as pipeline_lib
 from tpuddp.utils import batching
-from tpuddp.training.step import accumulate_metrics, finalize_metrics, stack_batches
+from tpuddp.training.step import finalize_metrics
 
 logger = logging.getLogger("tpuddp")
 
@@ -105,21 +105,6 @@ def _param_bytes(params) -> int:
     )
 
 
-def _pad_to_cycles(chunk, accum: int):
-    """Pad a ragged tail chunk with all-padding (weight-0) micro-batches to a
-    whole number of accumulation cycles. Padding batches carry zero sample
-    weight, so they contribute nothing to gradients, metrics, or BatchNorm
-    statistics (nn/loss.py, nn/norm.py) — the cycle's update averages over
-    the live samples only. Cost: up to ``accum - 1`` wasted tail micro-steps
-    per epoch (each pad batch pays a full forward+backward whose result is
-    masked to zero) — bounded, once per epoch, and the price of keeping the
-    scan shape static; epochs whose batch count is a multiple of ``accum``
-    pay nothing."""
-    x0, y0, w0 = chunk[-1]
-    pad = (-len(chunk)) % accum
-    return chunk + [(x0, y0, np.zeros_like(w0))] * pad
-
-
 def _never():
     return False
 
@@ -127,83 +112,21 @@ def _never():
 def _fused_pass(
     ddp, state, loader, scan_k: int, step_one, step_many, probe_cb=None,
     accum: int = 1, poll=preemption_requested, inject_cb=None, tel=None,
+    pipeline: Optional[pipeline_lib.PipelineConfig] = None,
 ):
-    """One pass over ``loader`` with K-fused dispatch + one-chunk upload
-    lookahead (device_put is async, so staging chunk N+1 before dispatching N
-    overlaps host->HBM transfer with the previous dispatch's compute). Shared
-    by the train and eval passes; ``step_*(state, batch) -> (state, metrics)``.
-    ``accum > 1``: chunks arrive at ``step_many`` as whole accumulation
-    cycles (``scan_k`` is a multiple of ``accum``; the ragged tail is padded).
-    Returns ``(state, accumulated_metrics, interrupted)``: ``poll`` (the
-    preemption flag on single-host runs — one Event.is_set per dispatch, free
-    next to a device step) is checked at every batch-group boundary and an
-    interrupted pass returns early with the state as of the last *completed*
-    dispatch, for the emergency checkpoint. Multi-host runs pass ``_never``:
-    one host bailing out of the pass mid-epoch while its peers keep issuing
-    step collectives would wedge the pod, so the drain decision moves to the
-    epoch boundary where it can be agreed globally. ``inject_cb`` (the
-    ``nan@step=N`` chaos hook) may rewrite each host batch before it is
-    staged — wired only while an un-fired nan fault is armed. ``tel`` (a
-    :class:`~tpuddp.observability.RunTelemetry`; None -> inert) brackets
-    each dispatch with its host-side pre/post hooks — per-step wall times
-    and the $TPUDDP_PROFILE_STEPS window, never touching the compiled
-    program."""
-    if tel is None:
-        tel = telemetry_lib.NULL  # every dispatch site hooks unconditionally
-    acc = None
-    chunk = []
-    staged = None
-    staged_samples = 0
-    for batch_idx, host_batch in enumerate(loader):
-        if inject_cb is not None:
-            host_batch = inject_cb(host_batch)
-        if probe_cb is not None:
-            probe_cb(batch_idx, host_batch)
-        tel.offer_batch(host_batch)
-        if poll():
-            return state, acc, True
-        if scan_k <= 1 and accum <= 1:
-            tel.pre_dispatch(1)
-            state, metrics = step_one(state, ddp.shard(host_batch))
-            acc = accumulate_metrics(acc, metrics)
-            tel.post_dispatch(1, len(host_batch[1]), metrics)
-            continue
-        chunk.append(host_batch)
-        if len(chunk) == scan_k:
-            next_samples = sum(len(b[1]) for b in chunk)
-            next_staged = ddp.shard_stacked(stack_batches(chunk))
-            chunk = []
-            if staged is not None:
-                tel.pre_dispatch(scan_k)
-                state, metrics = step_many(state, staged)
-                acc = accumulate_metrics(acc, metrics)
-                tel.post_dispatch(scan_k, staged_samples, metrics)
-            staged, staged_samples = next_staged, next_samples
-    if poll():
-        return state, acc, True
-    if staged is not None:
-        tel.pre_dispatch(scan_k)
-        state, metrics = step_many(state, staged)
-        acc = accumulate_metrics(acc, metrics)
-        tel.post_dispatch(scan_k, staged_samples, metrics)
-    if chunk and accum > 1:
-        # tail under accumulation: pad to whole cycles, one scan dispatch
-        # (a per-batch step would fire a full-scale update per micro-batch)
-        tail_samples = sum(len(b[1]) for b in chunk)
-        tail = _pad_to_cycles(chunk, accum)
-        tel.pre_dispatch(len(tail))
-        state, metrics = step_many(state, ddp.shard_stacked(stack_batches(tail)))
-        acc = accumulate_metrics(acc, metrics)
-        tel.post_dispatch(len(tail), tail_samples, metrics)
-        return state, acc, poll()
-    for host_batch in chunk:  # remainder: single steps, same semantics
-        if poll():
-            return state, acc, True
-        tel.pre_dispatch(1)
-        state, metrics = step_one(state, ddp.shard(host_batch))
-        acc = accumulate_metrics(acc, metrics)
-        tel.post_dispatch(1, len(host_batch[1]), metrics)
-    return state, acc, poll()
+    """One pass over ``loader`` — the async pipelined runner
+    (:mod:`tpuddp.training.pipeline`): K-fused dispatch, a ``depth``-chunk
+    staged device queue (host->HBM transfers overlap the previous dispatch's
+    compute), and a deferred readback drain. ``pipeline`` (None -> the
+    default config) only changes *when* host work happens, never what is
+    dispatched: results are bitwise identical at every depth. See
+    :func:`tpuddp.training.pipeline.run_pass` for the full contract."""
+    return pipeline_lib.run_pass(
+        ddp, state, loader, scan_k, step_one, step_many,
+        cfg=pipeline if pipeline is not None else pipeline_lib.DEFAULT,
+        probe_cb=probe_cb, accum=accum, poll=poll, inject_cb=inject_cb,
+        tel=tel,
+    )
 
 
 def run_training_loop(
@@ -224,6 +147,7 @@ def run_training_loop(
     keep_last: Optional[int] = None,
     step_stats_every: int = 0,
     run_meta: Optional[dict] = None,
+    pipeline=None,
     log=print,
 ):
     """Run the full training loop; returns ``(state, history)`` where history
@@ -260,8 +184,15 @@ def run_training_loop(
     fields (config hash, model, dataset) into the header row. Profiling:
     ``$TPUDDP_PROFILE`` (first epoch), ``$TPUDDP_PROFILE_STEPS=a:b`` (step
     window), SIGUSR1 (trace the next epoch of a live run).
+
+    Async pipeline (``pipeline``, the ``training.pipeline`` block — see
+    :mod:`tpuddp.training.pipeline`): depth of the staged device chunk
+    queue, host loader workers, and the synchronous A/B mode. Bitwise
+    identical to the synchronous path at every depth; ``step_stats`` windows
+    gain the occupancy fields (host_stall_ms, staging/in-flight depth).
     """
     is_main = jax.process_index() == 0
+    pipeline = pipeline_lib.resolve_pipeline(pipeline)
     pbytes = _param_bytes(state.params) if hasattr(state, "params") else None
     eval_scan_steps = (
         resolve_scan_steps(
@@ -339,6 +270,7 @@ def run_training_loop(
         "start_epoch": start_epoch,
         "num_epochs": num_epochs,
         "step_stats_every": int(step_stats_every or 0),
+        "pipeline": pipeline.as_dict(),
         "grad_comm_bytes_per_update": getattr(
             ddp, "grad_comm_bytes_per_step", None
         ),
@@ -559,6 +491,7 @@ def run_training_loop(
                 ddp, state, train_loader, scan_steps,
                 ddp.train_step, ddp.train_step_many, probe_cb=train_probe,
                 accum=accum, poll=poll, inject_cb=nan_inject, tel=tel,
+                pipeline=pipeline,
             )
             if interrupted:
                 emergency_stop(epoch)
@@ -570,7 +503,7 @@ def run_training_loop(
                 ddp, state, test_loader, eval_scan_steps,
                 lambda s, b: (s, ddp.eval_step(s, b)),
                 lambda s, b: (s, ddp.eval_step_many(s, b)),
-                poll=poll,
+                poll=poll, pipeline=pipeline,
             )
             if interrupted:
                 emergency_stop(epoch, completed=True)

@@ -59,15 +59,23 @@ def setup_dataloaders(training):
 
 def train(
     model, train_loader, criterion, optimizer, accelerator, augment,
-    deferred=False, tel=None,
+    tel=None,
 ):
     """One training epoch. Returns ``(mean_batch_loss, samples_seen)`` —
     the weighted sample count feeds the history.jsonl throughput fields.
     ``tel`` (observability.RunTelemetry) brackets each optimizer step with
     its host-side timing/profiling hooks; under fuse_steps the laps measure
-    dispatch rate (the queue flushes every K steps), never forcing a flush."""
+    dispatch rate (the queue flushes every K steps), never forcing a flush.
+
+    Deferred readback (the async pipeline, tpuddp/training/pipeline.py): the
+    per-batch ``loss.item()`` host sync the reference pays (quirk Q5) is
+    retired on BOTH metric modes — losses are collected as LazyLoss objects
+    and become observable at the end-of-epoch drain (or whenever a fuse-queue
+    flush materializes them earlier); the loop itself never fences the
+    device. ``augment=None`` means augmentation is folded INTO the compiled
+    step (``Accelerator(augment=...)``) and raw decoded batches feed
+    ``model(...)`` directly — host workers only decode and stack."""
     model.train()
-    running_loss = 0.0
     n_seen = 0.0
     batch_losses = []
     # fuse_steps bookkeeping for the step recorder: an optimizer.step() that
@@ -76,29 +84,44 @@ def train(
     # as p50 while the Kth lap absorbs K steps of work. Steps accumulate here
     # and are posted as ONE group when the queue has actually drained.
     pend_steps, pend_samples = 0, 0
+    from tpuddp.training.pipeline import StallClock, stalled_iter
+
+    stall = StallClock()  # host-blocked time -> step_stats occupancy fields
+    # deepest the fuse queue ran since the last posted group — sampled at
+    # enqueue time (post time always sees a just-drained queue)
+    queue_peak = [0]
 
     def post_if_flushed(force=False):
         nonlocal pend_steps, pend_samples
         if tel is None or pend_steps == 0:
             return
         if force or not getattr(optimizer, "_queue", None):
-            tel.post_dispatch(pend_steps, int(pend_samples))
+            tel.post_dispatch(
+                pend_steps, int(pend_samples), host_stall_s=stall.take(),
+                inflight_depth=queue_peak[0],
+            )
             pend_steps, pend_samples = 0, 0
+            queue_peak[0] = 0
 
-    # ONE fresh key per epoch; the per-batch key is fold_in(base, i) INSIDE
-    # the jitted augment — an eager split per batch would be a device
-    # dispatch of its own (measured ~3 ms on tunneled runtimes)
-    aug_base = accelerator.next_rng_key()
-    for i, (inputs, labels, weights) in enumerate(train_loader):
+    # ONE fresh key per epoch when augmentation runs as its own jitted op
+    # (device_augment: false); with in-step augment the key derives from the
+    # step rng inside the compiled program and no epoch key is drawn.
+    aug_base = accelerator.next_rng_key() if augment is not None else None
+    for i, (inputs, labels, weights) in enumerate(
+        stalled_iter(train_loader, stall)
+    ):
         # no .to(device): placement is the backend's job (reference :44 note)
         batch_n = float(np.sum(weights))
         n_seen += batch_n
         optimizer.zero_grad()
 
-        # Flip-augmented inputs (reference transform_train includes
-        # RandomHorizontalFlip, data_and_toy_model.py:14-19), keyed off the
-        # accelerator's per-process PRNG stream.
-        x = augment(aug_base, i, jnp.asarray(inputs))
+        if augment is not None:
+            # Flip-augmented inputs (reference transform_train includes
+            # RandomHorizontalFlip, data_and_toy_model.py:14-19), keyed off
+            # the accelerator's per-process PRNG stream.
+            x = augment(aug_base, i, jnp.asarray(inputs))
+        else:
+            x = inputs  # normalize/flip/resize run inside the step program
 
         if tel is not None:
             # the step about to be enqueued is global_step + pend_steps, and
@@ -117,27 +140,26 @@ def train(
         optimizer.step()
         pend_steps += 1
         pend_samples += batch_n
+        queue_peak[0] = max(
+            queue_peak[0], len(getattr(optimizer, "_queue", ()) or ())
+        )
         post_if_flushed()
 
-        if deferred:
-            # collect the LazyLoss objects; values materialize when the
-            # fuse_steps queue flushes (reading device_value here would
-            # force a flush per batch and defeat the fusion)
-            batch_losses.append(loss)
-        else:
-            running_loss += loss.item()  # per-batch host sync (Q5 parity mode)
+        # collect the LazyLoss; its value materializes when the fuse queue
+        # flushes (or at the epoch-end drain) — never a per-batch host sync
+        batch_losses.append(loss)
     # a partial gradient-accumulation cycle applies at dataloader end (the
     # HF accumulate() contract) instead of leaking into the next epoch
     flush_accum = getattr(optimizer, "flush_accumulation", None)
     if flush_accum is not None:
         flush_accum()
-    if deferred:
-        # Sum on device (array-at-a-time over fused flushes), ONE host fetch
-        # — per-batch scalar reads cost a dispatch each and dominate the
-        # steps themselves on dispatch-latency-bound runtimes.
-        from tpuddp.accelerate import sum_losses
+    # the deferred readback drain: sum on device (array-at-a-time over fused
+    # flushes), ONE host fetch — per-batch scalar reads cost a dispatch AND a
+    # round trip each, and dominated the steps themselves on
+    # dispatch-latency-bound runtimes (BASELINE.md's 1,532 samples/s row)
+    from tpuddp.accelerate import sum_losses
 
-        running_loss = float(sum_losses(batch_losses))
+    running_loss = float(sum_losses(batch_losses))
     # a ragged tail left in the fuse queue was flushed by sum_losses (or by
     # flush_accumulation above): attribute its steps now, post-fence
     post_if_flushed(force=True)
@@ -205,6 +227,7 @@ def run_training_loop(
     start_epoch=0,
     step_stats_every=0,
     run_meta=None,
+    pipeline=None,
 ):
     # Observability parity with the native epoch driver (training/loop.py):
     # the typed run_meta header opens history.jsonl, epoch rows carry the
@@ -223,9 +246,12 @@ def run_training_loop(
     from tpuddp.resilience import faults
     from tpuddp.resilience import guard as guard_lib
 
+    from tpuddp.training.pipeline import resolve_pipeline
+
     metrics_writer = MetricsWriter(save_dir)
     profiling = maybe_start_profiler(save_dir)
     guard_cfg = guard_lib.resolve_guard(getattr(accelerator, "guard", None))
+    pipeline = resolve_pipeline(pipeline)
     # elastic resume (ISSUE 7): load_state stashed any topology-change events
     # (the restored state was written on a different world size); the header
     # names the provenance and the typed event rows land right after it
@@ -239,6 +265,7 @@ def run_training_loop(
         "start_epoch": start_epoch,
         "num_epochs": num_epochs,
         "step_stats_every": int(step_stats_every or 0),
+        "pipeline": pipeline.as_dict(),
         **(run_meta or {}),
     }
     topo_change = next(
@@ -373,12 +400,11 @@ def run_training_loop(
                 optimizer,
                 accelerator,
                 augment,
-                deferred=deferred_metrics,
                 tel=tel,
             )
-            # the train pass is done (deferred mode just materialized its
-            # losses — the fence); summarize before eval time can leak in,
-            # but keep any SIGUSR1 epoch trace running through evaluation
+            # the train pass is done (its end-of-epoch drain materialized
+            # the losses — the fence); summarize before eval time can leak
+            # in, but keep any SIGUSR1 epoch trace running through evaluation
             step_fields = tel.end_epoch(stop_trace=False)
             if preemption_requested():
                 # the train pass completed, so every update of this epoch is
@@ -513,6 +539,24 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         # loss.item() per batch flushes the queue every step); "auto" then
         # resolves size-aware inside the Accelerator at the first step
         fuse = "auto" if training.get("deferred_metrics") else 1
+    # async pipeline config (training.pipeline): staged depth / host workers
+    # / in-step augment; resolved once, recorded in the run_meta header
+    from tpuddp.training.pipeline import resolve_pipeline
+
+    pipeline_cfg = resolve_pipeline(training.get("pipeline"))
+    # augmentation pipeline: with pipeline.device_augment (the default) the
+    # normalize/flip/resize is folded INTO the compiled step programs
+    # (Accelerator(augment=...)) so the host loop feeds raw decoded batches
+    # — one dispatch per step, host workers only decode and stack
+    mean, std = norm_stats_for(training)
+    cdtype = compute_dtype_for(training)
+    _aug = make_train_augment(
+        size=training.get("image_size"),
+        flip=flip_for(training),
+        mean=mean,
+        std=std,
+        compute_dtype=cdtype,
+    )
     # an EXPLICIT fuse_steps conflicting with accumulation surfaces the
     # library's own mutually-exclusive error instead of a silent override
     accelerator = Accelerator(
@@ -529,6 +573,7 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         # numerical guard (resilience/guard.py): non-finite-update firewall
         # in the fused/scan/accumulation programs + prepare-time desync audit
         guard=training.get("guard"),
+        augment=_aug if pipeline_cfg.device_augment else None,
     )
 
     # Data + model (reference :118-122); placement is implicit on this path.
@@ -548,30 +593,30 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         model, optimizer, train_loader
     )
 
-    if training.get("prefetch", True):
+    if training.get("prefetch", True) and pipeline_cfg.host_workers > 0:
         from tpuddp.accelerate import StagedUploadLoader
         from tpuddp.data import PrefetchLoader
 
         # host batch assembly overlaps device compute (PrefetchLoader, the
-        # reference's num_workers analog) and batch N+1's host->device upload
+        # reference's num_workers analog; workers > 1 parallelize assembly
+        # over the loader's batch plan) and batch N+1's host->device upload
         # is issued while batch N's step runs (StagedUploadLoader)
-        training_dataloader = StagedUploadLoader(PrefetchLoader(training_dataloader))
-        test_loader = StagedUploadLoader(PrefetchLoader(test_loader))
+        training_dataloader = StagedUploadLoader(
+            PrefetchLoader(training_dataloader, workers=pipeline_cfg.host_workers)
+        )
+        test_loader = StagedUploadLoader(
+            PrefetchLoader(test_loader, workers=pipeline_cfg.host_workers)
+        )
 
-    # jitted so each runs as one fused device op, not eager op-by-op;
-    # normalization stats follow the dataset, flip is a config knob
-    mean, std = norm_stats_for(training)
-    cdtype = compute_dtype_for(training)
-    _aug = make_train_augment(
-        size=training.get("image_size"),
-        flip=flip_for(training),
-        mean=mean,
-        std=std,
-        compute_dtype=cdtype,
-    )
-    # (base_key, batch_index, x): the per-batch key derivation happens inside
-    # the jit (see train()'s aug_base note)
-    augment = jax.jit(lambda rng, i, x: _aug(jax.random.fold_in(rng, i), x))
+    if pipeline_cfg.device_augment:
+        # augment is compiled into the step programs (Accelerator(augment=)
+        # above); train() feeds raw decoded batches straight to model(...)
+        augment = None
+    else:
+        # legacy cadence: one separate jitted augment dispatch per batch;
+        # (base_key, batch_index, x) — the per-batch key derivation happens
+        # inside the jit (see train()'s aug_base note)
+        augment = jax.jit(lambda rng, i, x: _aug(jax.random.fold_in(rng, i), x))
     eval_transform = jax.jit(
         make_eval_transform(
             size=training.get("image_size"), mean=mean, std=std,
@@ -616,6 +661,7 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         deferred_metrics=bool(training.get("deferred_metrics")),
         start_epoch=start_epoch,
         step_stats_every=int(training.get("step_stats_every") or 0),
+        pipeline=pipeline_cfg,
         # run provenance for the history header: which configuration was this?
         run_meta={
             "config_hash": config_hash(training),

@@ -65,11 +65,17 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
     test_loader = ShardedDataLoader(
         test_ds, training["test_batch_size"], mesh, shuffle=True
     )
-    if training.get("prefetch", True):
+    # async pipeline (training.pipeline, tpuddp/training/pipeline.py):
+    # staged-chunk depth + host worker count + the synchronous A/B mode
+    from tpuddp.training.pipeline import resolve_pipeline
+
+    pipeline = resolve_pipeline(training.get("pipeline"))
+    if training.get("prefetch", True) and pipeline.host_workers > 0:
         # overlap host batch assembly with device compute (the reference's
-        # num_workers=2 analog, multi-GPU-training-torch.py:90-98)
-        train_loader = PrefetchLoader(train_loader)
-        test_loader = PrefetchLoader(test_loader)
+        # num_workers analog, multi-GPU-training-torch.py:90-98); workers > 1
+        # parallelize assembly itself over the loaders' batch plan
+        train_loader = PrefetchLoader(train_loader, workers=pipeline.host_workers)
+        test_loader = PrefetchLoader(test_loader, workers=pipeline.host_workers)
 
     # Device-side transform pipeline (replaces data_and_toy_model.py:13-29);
     # normalization stats follow the dataset, and flip is a config knob
@@ -172,6 +178,7 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         # telemetry (tpuddp.observability): per-window step_stats cadence +
         # run provenance for the history.jsonl run_meta header
         step_stats_every=int(training.get("step_stats_every") or 0),
+        pipeline=pipeline,
         run_meta={
             "config_hash": obs.config_hash(training),
             "model": training.get("model"),
