@@ -667,6 +667,131 @@ def bench_pipeline_pair(batch_per_chip=64, n_train=4096, repeats=2, scan=8):
     return rows[True]["sps"], rows[False]["sps"]
 
 
+def bench_comm_matrix(batch_per_chip=64, steps=96, density=0.1):
+    """The comm-compression-v2 A/B matrix (``--comm``): every hook
+    (none/bf16_ef/int8_ef/topk_ef) x topology (flat/hierarchical) pair on
+    the same fixed toy-MLP workload over all local devices — the ISSUE 9
+    acceptance artifact (BENCH_r07.json). Per row: throughput, per-step
+    gradient wire bytes (total + the inter-/intra-host hop split), the
+    hook's density, and the final mean loss. In-run assertions make the
+    artifact self-verifying rather than a claim:
+
+    - ``int8_ef`` cuts >= 70% and ``topk_ef`` (density 0.1) >= 85% of the
+      f32 gradient wire bytes on the explicit flat path;
+    - every compressed run's final loss tracks the uncompressed flat run
+      within the documented per-hook bound
+      (:func:`tpuddp.parallel.comm.loss_parity_tol` — topk_ef's error
+      feedback warms up over ~1/density updates, hence ``steps=96``: the
+      matrix compares trajectories past the warmup, where the bound is
+      meaningful);
+    - hierarchical topology's inter-host bytes are strictly below the flat
+      topology's total for the same hook (the reason the topology exists).
+
+    Returns ``(int8_flat_sps, none_flat_sps)`` for the summary line."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpuddp import nn, optim
+    from tpuddp.parallel import comm as comm_lib
+    from tpuddp.parallel import make_mesh
+    from tpuddp.parallel.ddp import DistributedDataParallel
+    from tpuddp.parallel.mesh import hierarchical_mesh
+    from tpuddp.models import ToyMLP
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    global_batch = batch_per_chip * n_chips
+    rng = np.random.RandomState(7)
+    x = rng.randn(global_batch, 8, 8, 3).astype(np.float32)
+    y = rng.randint(0, 10, global_batch).astype(np.int32)
+    w = np.ones(global_batch, np.float32)
+
+    topologies = ["flat"]
+    if n_chips % 2 == 0 and n_chips >= 2:
+        topologies.append("hierarchical")
+    else:
+        log(f"comm matrix: hierarchical rows skipped ({n_chips} devices "
+            "do not factor into a (host, local) split)")
+
+    stats = {}
+    for topology in topologies:
+        mesh = (
+            hierarchical_mesh(devices=devices)
+            if topology == "hierarchical"
+            else make_mesh(devices)
+        )
+        for hook in ("none", "bf16_ef", "int8_ef", "topk_ef"):
+            ddp = DistributedDataParallel(
+                ToyMLP(hidden=(16,)), optim.Adam(1e-2),
+                nn.CrossEntropyLoss(), mesh=mesh, mode="shard_map",
+                comm_hook=hook, comm_topology=topology, topk_density=density,
+            )
+            state = ddp.init_state(
+                jax.random.key(0), jnp.zeros((1, 8, 8, 3))
+            )
+            batch = ddp.shard((x, y, w))
+            metrics = None
+            for _ in range(3):  # compile + warm
+                state, metrics = ddp.train_step(state, batch)
+            float(np.sum(np.asarray(metrics["loss_sum"])))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = ddp.train_step(state, batch)
+            loss_sum = float(np.sum(np.asarray(metrics["loss_sum"])))  # fence
+            dt = time.perf_counter() - t0
+            final_loss = loss_sum / float(np.sum(np.asarray(metrics["n"])))
+            assert np.isfinite(final_loss), (hook, topology)
+            name = f"toy_mlp b{batch_per_chip} comm {hook} {topology}"
+            sps = steps * global_batch / dt
+            extra = {
+                "comm_hook": hook,
+                "comm_topology": topology,
+                "comm_density": density if hook == "topk_ef" else None,
+                "grad_comm_bytes_per_step": int(ddp.grad_comm_bytes_per_step),
+                "grad_comm_bytes_per_step_f32": int(
+                    ddp.grad_comm_bytes_per_step_f32
+                ),
+                "grad_comm_bytes_inter_host": int(
+                    ddp.grad_comm_bytes_inter_host
+                ),
+                "grad_comm_bytes_intra_host": int(
+                    ddp.grad_comm_bytes_intra_host
+                ),
+                "final_loss": round(final_loss, 6),
+            }
+            _record(name, sps / n_chips, dt / steps * 1e3, None, extra)
+            stats[(hook, topology)] = {
+                "sps": sps / n_chips, "loss": final_loss, **extra,
+            }
+
+    base = stats[("none", "flat")]
+    f32 = base["grad_comm_bytes_per_step_f32"]
+    for hook, floor in (("int8_ef", 0.70), ("topk_ef", 0.85)):
+        cut = 1 - stats[(hook, "flat")]["grad_comm_bytes_per_step"] / f32
+        assert cut >= floor, (
+            f"{hook}: {cut * 100:.1f}% byte cut is under the {floor * 100:.0f}% "
+            "acceptance floor"
+        )
+        log(f"comm matrix: {hook} cuts {cut * 100:.1f}% of gradient wire bytes")
+    for (hook, topology), row in stats.items():
+        tol = comm_lib.loss_parity_tol(hook, base["loss"])
+        assert abs(row["loss"] - base["loss"]) <= tol, (
+            f"{hook}/{topology}: final loss {row['loss']:.4f} diverged from "
+            f"uncompressed {base['loss']:.4f} (documented tol {tol:.4f})"
+        )
+    if "hierarchical" in topologies:
+        for hook in ("none", "bf16_ef", "int8_ef", "topk_ef"):
+            flat_total = stats[(hook, "flat")]["grad_comm_bytes_per_step"]
+            inter = stats[(hook, "hierarchical")]["grad_comm_bytes_inter_host"]
+            assert inter < flat_total, (
+                f"{hook}: hierarchical inter-host bytes {inter} not below "
+                f"the flat total {flat_total}"
+            )
+        log("comm matrix: hierarchical inter-host bytes < flat totals for "
+            "every hook")
+    return stats[("int8_ef", "flat")]["sps"], base["sps"]
+
+
 def bench_torch_cpu(batch=128, steps=30, warmup=3):
     """The reference stack's hot loop (toy MLP) on this host (torch CPU)."""
     try:
@@ -776,6 +901,21 @@ def main(argv=None):
             log("--out needs a path argument")
             raise SystemExit(2)
         out_path = argv[i + 1]
+    if "--comm" in argv:
+        # the comm-compression-v2 A/B matrix (ISSUE 9 acceptance artifact):
+        # hook x topology rows with wire-byte accounting + in-run byte-cut /
+        # loss-parity / hierarchical-inter-host assertions; the headline is
+        # int8_ef-flat throughput against the uncompressed flat baseline
+        from tpuddp.observability import json_sanitize
+
+        int8_sps, none_sps = bench_comm_matrix()
+        summary = emit_summary(
+            int8_sps, none_sps, out_path=out_path,
+            metric="toy_mlp_int8_ef_train_samples_per_sec_per_chip",
+            basis="comm-hook-none",
+        )
+        print(json.dumps(json_sanitize(summary), allow_nan=False), flush=True)
+        return
     if "--pipeline" in argv:
         # the async-pipeline A/B mode: ONLY the loader-fed on/off pair, with
         # the pipeline-off (synchronous) row as the baseline basis — the

@@ -49,7 +49,7 @@ def make_batch(n=64, seed=5, shape=(8, 8, 3)):
     return x, y, np.ones(n, np.float32)
 
 
-def build(mesh, hook, mode="shard_map", wus=False, accum=1, cap=None):
+def build(mesh, hook, mode="shard_map", wus=False, accum=1, cap=None, **kw):
     return DistributedDataParallel(
         ToyMLP(hidden=(16,)),
         optim.Adam(1e-2),
@@ -60,6 +60,7 @@ def build(mesh, hook, mode="shard_map", wus=False, accum=1, cap=None):
         weight_update_sharding=wus,
         grad_accumulation=accum,
         **({"bucket_cap_mb": cap} if cap is not None else {}),
+        **kw,
     )
 
 
@@ -179,6 +180,109 @@ def test_auto_mode_counter_reports_f32_wire(cpu_devices):
     base = build(mesh, "none", mode="auto")
     base.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
     assert comp.grad_comm_bytes_per_step == base.grad_comm_bytes_per_step
+
+
+def test_comm_bytes_formula_per_hook():
+    """Satellite (ISSUE 9): the per-hook wire-byte formula, pinned exactly.
+    Sparse/quantized payloads must count EVERY wire part — int8 values, the
+    int32 top-k indices, and the per-bucket f32 scale scalars — and
+    ``wire=False`` (auto/managed, where the collective stays f32) must keep
+    reporting the f32 payload for every hook."""
+    p = {"w": jnp.zeros((40, 10)), "b": jnp.zeros((10,))}  # 410 raw elems
+    world, cap = 8, cap_mb(4096)  # one bucket: 410 -> padded 416
+    spec_total = 416
+    base = comm_lib.comm_bytes_for_hook(p, world, "none")
+    assert base == 410 * 4  # tree pmean reduces the raw elements
+    assert comm_lib.comm_bytes_for_hook(
+        p, world, "bf16", bucket_cap_mb=cap
+    ) == spec_total * 2
+    assert comm_lib.comm_bytes_for_hook(
+        p, world, "int8_ef", bucket_cap_mb=cap
+    ) == spec_total * 1 + 4  # int8 values + ONE f32 scale (one bucket)
+    k = comm_lib.bucket_topk(spec_total, 0.1)
+    assert comm_lib.comm_bytes_for_hook(
+        p, world, "topk_ef", bucket_cap_mb=cap, density=0.1
+    ) == k * (1 + 4) + 4  # int8 values + int32 indices + scale
+    # multi-bucket: scales are per bucket — 2 buckets => 2 scale scalars
+    from tpuddp.training.step import make_flat_param_spec
+
+    spec = make_flat_param_spec(p, world)
+    assert spec.total == spec_total
+    two = comm_lib.make_buckets(spec.sizes, spec.total, bucket_cap_mb=cap_mb(401))
+    assert len(two) == 2
+    sizes = [e - s for s, e in two]
+    assert comm_lib.comm_bytes_for_hook(
+        p, world, "int8_ef", bucket_cap_mb=cap_mb(401)
+    ) == sum(sizes) * 1 + 2 * 4
+    assert comm_lib.comm_bytes_for_hook(
+        p, world, "topk_ef", bucket_cap_mb=cap_mb(401), density=0.1
+    ) == sum(comm_lib.bucket_topk(b, 0.1) for b in sizes) * 5 + 2 * 4
+    # wus degenerates to ONE whole-vector bucket for every hook
+    assert comm_lib.comm_bytes_for_hook(
+        p, world, "int8_ef", wus=True
+    ) == spec_total * 1 + 4
+    # wire=False: auto/managed reduces f32 whatever the hook emulates
+    for hook in ("bf16_ef", "int8_ef", "topk_ef"):
+        assert comm_lib.comm_bytes_for_hook(
+            p, world, hook, wire=False
+        ) == base, hook
+
+
+def test_comm_bytes_acceptance_cuts():
+    """The acceptance floors as counter facts: int8_ef >= 70%, topk_ef at
+    density 0.1 >= 85% below the f32 payload on a realistic layout."""
+    p = {"w1": jnp.zeros((192, 64)), "b1": jnp.zeros((64,)),
+         "w2": jnp.zeros((64, 10)), "b2": jnp.zeros((10,))}
+    base = comm_lib.comm_bytes_for_hook(p, 8, "none")
+    for hook, floor in (("int8_ef", 0.70), ("topk_ef", 0.85)):
+        comp = comm_lib.comm_bytes_for_hook(p, 8, hook, density=0.1)
+        assert 1 - comp / base >= floor, (hook, comp, base)
+
+
+def test_comm_bytes_breakdown_hierarchical():
+    """Hierarchical accounting: intra-host = the f32 scatter + gather
+    operands, inter-host = the compressed shard payload — and the inter-host
+    share must sit below the flat topology's total for every hook."""
+    p = {"w": jnp.zeros((100, 10))}
+    world, local = 8, 4
+    from tpuddp.training.step import make_flat_param_spec
+
+    total = make_flat_param_spec(p, world).total
+    shard = total // local
+    for hook in ("none", "bf16_ef", "int8_ef", "topk_ef"):
+        flat = comm_lib.comm_bytes_breakdown(p, world, hook, topology="flat")
+        assert flat["intra_host"] == 0
+        assert flat["inter_host"] == flat["total"]
+        hier = comm_lib.comm_bytes_breakdown(
+            p, world, hook, topology="hierarchical", local_size=local
+        )
+        assert hier["intra_host"] == total * 4 + shard * 4
+        assert hier["inter_host"] < flat["total"], hook
+        assert hier["total"] == hier["intra_host"] + hier["inter_host"]
+    hier = comm_lib.comm_bytes_breakdown(
+        p, world, "int8_ef", topology="hierarchical", local_size=local
+    )
+    assert hier["inter_host"] == shard * 1 + 4
+    with pytest.raises(ValueError, match="local_size"):
+        comm_lib.comm_bytes_breakdown(p, world, "int8_ef", topology="hierarchical")
+    with pytest.raises(ValueError, match="comm_topology"):
+        comm_lib.comm_bytes_breakdown(p, world, "int8_ef", topology="ring")
+
+
+def test_topk_density_validation(cpu_devices):
+    with pytest.raises(ValueError, match="density"):
+        comm_lib.bucket_topk(100, 0.0)
+    with pytest.raises(ValueError, match="density"):
+        comm_lib.bucket_topk(100, 1.5)
+    assert comm_lib.bucket_topk(100, 0.1) == 10
+    assert comm_lib.bucket_topk(3, 0.1) == 1  # never an empty send
+    mesh = make_mesh(cpu_devices)
+    with pytest.raises(ValueError, match="density"):
+        build(mesh, "topk_ef", topk_density=2.0)
+    from tpuddp.accelerate import Accelerator
+
+    with pytest.raises(ValueError, match="density"):
+        Accelerator(mesh=mesh, topk_density=0.0)
 
 
 def test_comm_bytes_counter_class():
@@ -306,6 +410,141 @@ def test_bf16_ef_scan_fused_and_accumulation(cpu_devices):
     assert np.any(np.asarray(st.comm_state) != 0)
 
 
+@pytest.mark.parametrize("mode", ["shard_map", "auto"])
+@pytest.mark.parametrize("hook", ["int8_ef", "topk_ef"])
+def test_quantized_sparse_training_tracks_f32_loss(cpu_devices, mode, hook):
+    """Comm compression v2: int8_ef/topk_ef stay within their documented
+    parity bound of the uncompressed trajectory (topk_ef compared past its
+    ~1/density-update error-feedback warmup) and carry a live residual."""
+    steps = 24 if hook == "topk_ef" else 8
+    mesh = make_mesh(cpu_devices)
+    _, base = _run_steps(build(mesh, "none", mode=mode), steps=steps)
+    st, comp = _run_steps(build(mesh, hook, mode=mode), steps=steps)
+    assert np.isfinite(comp)
+    assert abs(comp - base) <= comm_lib.loss_parity_tol(hook, base), (
+        hook, mode, comp, base,
+    )
+    leaves = jax.tree_util.tree_leaves(st.comm_state)
+    assert leaves and any(np.any(np.asarray(l) != 0) for l in leaves)
+
+
+@pytest.mark.parametrize("hook", ["int8_ef", "topk_ef"])
+def test_quantized_sparse_composes_with_wus(cpu_devices, hook):
+    steps = 24 if hook == "topk_ef" else 8
+    mesh = make_mesh(cpu_devices)
+    _, base = _run_steps(build(mesh, "none", wus=True), steps=steps)
+    st, comp = _run_steps(build(mesh, hook, wus=True), steps=steps)
+    assert abs(comp - base) <= comm_lib.loss_parity_tol(hook, base)
+    assert np.any(np.asarray(st.comm_state) != 0)
+
+
+def test_int8_scan_fused_and_accumulation(cpu_devices):
+    """The int8 residual threads through the lax.scan carry exactly like
+    bf16_ef's: K fused steps at accum=2 stay on the f32 trajectory."""
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    k = 4
+
+    def run(hook):
+        ddp = build(mesh, hook, accum=2)
+        st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        stacked = ddp.shard_stacked(stack_batches([(x, y, w)] * k))
+        m = None
+        for _ in range(4):
+            st, m = ddp.train_step_many(st, stacked)
+        loss = float(np.sum(np.asarray(m["loss_sum"]))) / float(
+            np.sum(np.asarray(m["n"]))
+        )
+        return st, loss
+
+    _, base = run("none")
+    st, comp = run("int8_ef")
+    assert np.isfinite(comp)
+    assert abs(comp - base) <= comm_lib.loss_parity_tol("int8_ef", base)
+    assert np.any(np.asarray(st.comm_state) != 0)
+
+
+# ------------------------------------------------- hierarchical topology --
+
+
+def hier_build(cpu_devices, hook, **kw):
+    from tpuddp.parallel.mesh import hierarchical_mesh
+
+    mesh = hierarchical_mesh(devices=cpu_devices)
+    return build(mesh, hook, comm_topology="hierarchical", **kw)
+
+
+def test_hierarchical_none_matches_flat_pmean(cpu_devices):
+    """hook "none" under the hierarchical topology is pure re-bracketing
+    (f32 scatter -> f32 psum -> gather): same trajectory as the flat pmean
+    up to summation order."""
+    mesh = make_mesh(cpu_devices)
+    _, base = _run_steps(build(mesh, "none"))
+    _, hier = _run_steps(hier_build(cpu_devices, "none"))
+    np.testing.assert_allclose(hier, base, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("hook", ["bf16_ef", "int8_ef", "topk_ef"])
+def test_hierarchical_compressed_tracks_f32(cpu_devices, hook):
+    steps = 24 if hook == "topk_ef" else 8
+    mesh = make_mesh(cpu_devices)
+    _, base = _run_steps(build(mesh, "none"), steps=steps)
+    st, comp = _run_steps(hier_build(cpu_devices, hook), steps=steps)
+    assert np.isfinite(comp)
+    assert abs(comp - base) <= comm_lib.loss_parity_tol(hook, base), (
+        hook, comp, base,
+    )
+    # the residual is per-replica sharded state, live after training
+    assert np.any(np.asarray(st.comm_state) != 0)
+
+
+def test_hierarchical_inter_host_bytes_below_flat(cpu_devices):
+    """The topology's acceptance contract: for every hook, the compressed
+    inter-host payload is strictly below the flat topology's total."""
+    mesh = make_mesh(cpu_devices)
+    for hook in ("none", "bf16_ef", "int8_ef", "topk_ef"):
+        flat = build(mesh, hook)
+        flat.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        hier = hier_build(cpu_devices, hook)
+        hier.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+        assert hier.grad_comm_bytes_inter_host < flat.grad_comm_bytes_per_step
+        assert hier.grad_comm_bytes_intra_host > 0
+        assert flat.grad_comm_bytes_intra_host == 0
+
+
+def test_hierarchical_refuses_bad_compositions(cpu_devices):
+    mesh = make_mesh(cpu_devices)
+    with pytest.raises(ValueError, match="hierarchical"):
+        build(mesh, "int8_ef", comm_topology="hierarchical")  # 1-D mesh
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        hier_build(cpu_devices, "int8_ef", wus=True)
+    with pytest.raises(ValueError, match="shard_map"):
+        hier_build(cpu_devices, "int8_ef", mode="auto")
+    with pytest.raises(ValueError, match="comm_topology"):
+        build(mesh, "int8_ef", comm_topology="ring")
+    from tpuddp.accelerate import Accelerator
+
+    with pytest.raises(ValueError, match="explicit"):
+        Accelerator(mesh=mesh, comm_topology="hierarchical")
+    from tpuddp.parallel.mesh import hierarchical_mesh
+
+    with pytest.raises(ValueError, match="factorable"):
+        hierarchical_mesh(devices=cpu_devices[:3])
+
+
+def test_lowered_step_requests_int8_allgather(cpu_devices):
+    """The explicit int8 step's lowered program carries the compressed
+    payload as the collective operand: an i8-element all-gather."""
+    mesh = make_mesh(cpu_devices)
+    x, y, w = make_batch()
+    ddp = build(mesh, "int8_ef")
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    window = _collective_window(
+        ddp, st, ddp.shard((x, y, w)), "stablehlo.all_gather"
+    )
+    assert "xi8>" in window, window[:300]
+
+
 def test_local_quantize_error_feedback_conserves():
     """The managed emulation's invariant: quantized + new_residual == grads +
     old_residual exactly (both sides are the same f32 subtraction)."""
@@ -327,13 +566,44 @@ def test_local_quantize_error_feedback_conserves():
     assert r3 is None and np.any(np.asarray(q3["w"]) != np.asarray(g["w"]))
 
 
+@pytest.mark.parametrize("hook", ["int8_ef", "topk_ef"])
+def test_local_quantize_int8_topk_conserves(hook):
+    """The managed emulation of the quantized/sparse hooks keeps the EF
+    invariant exactly (quantized + residual == send, both sides the same
+    f32 subtraction), produces genuinely int8-representable values, and —
+    for topk — keeps at most ceil(density * n) nonzeros per leaf."""
+    vals = np.random.RandomState(0).randn(64).astype(np.float32)
+    g = {"w": jnp.asarray(vals)}
+    r = comm_lib.init_residual_tree(g)
+    q, r1 = comm_lib.local_quantize(g, r, hook, density=0.25)
+    np.testing.assert_array_equal(
+        np.asarray(q["w"] + r1["w"]), np.asarray(g["w"] + r["w"])
+    )
+    qw = np.asarray(q["w"])
+    scale = np.abs(vals).max() / 127.0
+    codes = qw / scale
+    np.testing.assert_allclose(codes, np.round(codes), atol=1e-4)
+    assert np.abs(codes).max() <= 127.5
+    if hook == "topk_ef":
+        k = comm_lib.bucket_topk(64, 0.25)
+        assert np.count_nonzero(qw) <= k
+        # what it kept really is the top-|.| slice of the send
+        kept_idx = np.nonzero(qw)[0]
+        thresh = np.sort(np.abs(vals))[-k]
+        assert np.all(np.abs(vals[kept_idx]) >= thresh - 1e-6)
+
+
 # ------------------------------------------------------------ checkpoints --
 
 
-def test_native_residual_checkpoint_roundtrip(cpu_devices, tmp_path):
+@pytest.mark.parametrize("hook", ["bf16_ef", "int8_ef", "topk_ef"])
+def test_native_residual_checkpoint_roundtrip(cpu_devices, tmp_path, hook):
+    """Every EF hook's residual is training state: nonzero after steps,
+    lossless across the native checkpoint, trains on after restore (scales
+    are recomputed per step — never checkpointed, so nothing else rides)."""
     mesh = make_mesh(cpu_devices)
     x, y, w = make_batch()
-    ddp = build(mesh, "bf16_ef")
+    ddp = build(mesh, hook)
     st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
     for _ in range(3):
         st, _ = ddp.train_step(st, ddp.shard((x, y, w)))
@@ -341,7 +611,7 @@ def test_native_residual_checkpoint_roundtrip(cpu_devices, tmp_path):
     assert np.any(res != 0)
     path = ckpt.save(str(tmp_path / "ckpt_1.npz"), st)
     # a fresh same-shape state is the load template (the loop's resume path)
-    ddp2 = build(mesh, "bf16_ef")
+    ddp2 = build(mesh, hook)
     st2 = ddp2.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
     restored = ckpt.load(path, st2)
     np.testing.assert_array_equal(np.asarray(restored.comm_state), res)

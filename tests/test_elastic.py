@@ -148,6 +148,51 @@ def test_shrink_redistributes_residual_sum_preserving(cpu_devices, tmp_path):
     )
 
 
+@pytest.mark.parametrize("hook", ["int8_ef", "topk_ef"])
+def test_shrink_redistributes_quantized_sparse_residual(
+    cpu_devices, tmp_path, hook
+):
+    """Comm-compression-v2 satellite: the int8/top-k hooks' comm_state rides
+    the SAME v2 topology machinery as bf16_ef — a 4 -> 2 shrink
+    redistributes the residual sum-preservingly (bitwise group sums), the
+    per-bucket scales are recomputed in-jit rather than checkpointed (the
+    checkpoint holds exactly one comm_state leaf), and the restored state
+    trains on under the halved world."""
+    ddp4, s4 = build_world(cpu_devices, 4, comm_hook=hook)
+    mat, raw = residual_matrix(ddp4)
+    per4 = ddp4._wus_spec.total
+    s4 = with_residual(ddp4, s4, mat)
+    path = ckpt.save_on_main(str(tmp_path), 5, s4, world_size=4)
+    # scales are not state: comm_state is the only comm leaf in the file
+    with np.load(path) as data:
+        comm_keys = [k for k in data.files if "comm" in k]
+    assert comm_keys == [".comm_state"]
+    topo = ckpt.read_topology(path)
+    assert topo["leaves"][".comm_state"]["kind"] == "per_replica"
+
+    ddp2, s2 = build_world(cpu_devices, 2, comm_hook=hook)
+    per2 = ddp2._wus_spec.total
+    log = []
+    restored, nxt = ckpt.restore_latest(
+        str(tmp_path), s2, world_size=2, reshard_log=log
+    )
+    assert nxt == 6
+    got = np.asarray(restored.comm_state).reshape(2, per2)
+    cols = np.zeros((4, per2), np.float32)
+    keep = min(per4, per2)
+    cols[:, :keep] = mat[:, :keep]
+    np.testing.assert_array_equal(got, cols.reshape(2, 2, per2).sum(axis=1))
+    ev = [e for e in log if e["event"] == "topology_change"]
+    assert ev and ev[0]["residual"] == "redistributed"
+    # and the restored state trains on the halved world
+    x = np.random.RandomState(0).randn(8, 4, 4, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 10, 8).astype(np.int32)
+    st, m = ddp2.train_step(
+        restored, ddp2.shard((x, y, np.ones(8, np.float32)))
+    )
+    assert np.isfinite(float(np.sum(np.asarray(m["loss_sum"]))))
+
+
 def test_grow_places_residual_rows(cpu_devices, tmp_path):
     """2 -> 4 (N | M): old row r lands verbatim at new row 2r, the rest are
     zero — a pure placement, bitwise sum-preserving."""

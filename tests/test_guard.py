@@ -139,12 +139,17 @@ def test_nan_fault_spec_grammar():
 
 
 @pytest.mark.parametrize("mode", ["shard_map", "auto"])
-@pytest.mark.parametrize("hook", ["none", "bf16", "bf16_ef"])
+@pytest.mark.parametrize("hook", ["none", "bf16", "bf16_ef", "int8_ef", "topk_ef"])
 @pytest.mark.parametrize("clip", [None, 1.0])
 def test_firewall_skips_bitwise(cpu_devices, mode, hook, clip):
     """The acceptance matrix: a non-finite gradient leaves params, optimizer
     moments, and the EF residual bitwise untouched, counts the skip, and the
-    next finite step trains and resets ``consecutive``."""
+    next finite step trains and resets ``consecutive``. The int8/top-k hooks
+    ride the same contract: their NaN-poisoned max-abs scale decompresses
+    the whole payload to NaN (comm.quantize_int8's guard-visibility
+    contract), so the post-reduce f32 check still fires — and since scales
+    are recomputed in-jit each step, the bitwise-unchanged ``comm_state``
+    assertion doubles as the no-stale-scale-leakage proof."""
     mesh = make_mesh(cpu_devices)
     ddp = build(mesh, hook=hook, mode=mode, clip=clip)
     st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
@@ -183,6 +188,49 @@ def test_firewall_with_wus_and_clip(cpu_devices):
     st, _ = ddp.train_step(st, ddp.shard(bad))
     assert_bitwise_equal(before, snapshot(st))
     assert guard_lib.read_skip_counters(st) == (1, 1)
+
+
+@pytest.mark.parametrize("hook", ["int8_ef", "topk_ef"])
+def test_firewall_with_wus_quantized_hooks(cpu_devices, hook):
+    """The new hooks' WUS composition corner (structured int8/top-k payload
+    exchanged whole, own shard sliced): the skip preserves the sharded
+    moments AND the full-length residual bitwise."""
+    mesh = make_mesh(cpu_devices)
+    ddp = build(mesh, hook=hook, wus=True, clip=0.5)
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    good, bad = make_batch(), make_batch(nan=True)
+    st, _ = ddp.train_step(st, ddp.shard(good))
+    assert np.any(np.asarray(st.comm_state) != 0)  # EF residual is live
+    before = snapshot(st)
+    st, _ = ddp.train_step(st, ddp.shard(bad))
+    assert_bitwise_equal(before, snapshot(st))
+    assert guard_lib.read_skip_counters(st) == (1, 1)
+
+
+def test_firewall_hierarchical_topology(cpu_devices):
+    """The guard composes with the hierarchical multi-hop reduction: the
+    poisoned shard's NaN scale survives the inter-host exchange and the
+    all-gather, so every replica's post-reduce verdict agrees and the skip
+    is bitwise — residual (with its shard-placed error layout) included."""
+    from tpuddp.parallel.mesh import hierarchical_mesh
+
+    mesh = hierarchical_mesh(devices=cpu_devices)
+    ddp = DistributedDataParallel(
+        ToyMLP(hidden=(16,)), optim.Adam(1e-2), nn.CrossEntropyLoss(),
+        mesh=mesh, mode="shard_map", comm_hook="int8_ef",
+        comm_topology="hierarchical", guard=True,
+    )
+    st = ddp.init_state(KEY, jnp.zeros((1, 8, 8, 3)))
+    good, bad = make_batch(), make_batch(nan=True)
+    st, _ = ddp.train_step(st, ddp.shard(good))
+    assert np.any(np.asarray(st.comm_state) != 0)
+    before = snapshot(st)
+    st, _ = ddp.train_step(st, ddp.shard(bad))
+    assert_bitwise_equal(before, snapshot(st))
+    assert guard_lib.read_skip_counters(st) == (1, 1)
+    st, m = ddp.train_step(st, ddp.shard(good))  # recovers
+    assert np.isfinite(float(np.sum(np.asarray(m["loss_sum"]))))
+    assert guard_lib.read_skip_counters(st) == (1, 0)
 
 
 def test_firewall_skips_whole_accumulation_cycle(cpu_devices):

@@ -273,6 +273,33 @@ def test_schema_rejects_unknown_type_and_missing_header(tmp_path):
     assert any("invalid JSON" in e for e in errors)
 
 
+def test_schema_v4_requires_comm_topology(tmp_path):
+    """Comm-compression-v2 schema bump: a run_meta stamped at v4+ without
+    ``comm_topology`` is drift and must be rejected; older headers (v3 and
+    below, which predate the field) keep validating at their own version —
+    and the shared make_run_meta always carries the field."""
+    meta = schema_mod.make_run_meta(comm_hook="int8_ef", comm_topology="flat")
+    assert meta["schema_version"] >= 4
+    assert meta["comm_topology"] == "flat"
+    assert schema_mod.validate_history_records([meta]) == []
+    # null is legal (e.g. serving headers have no gradient comm)...
+    assert schema_mod.validate_history_records(
+        [schema_mod.make_run_meta(comm_hook=None)]
+    ) == []
+    # ...but ABSENCE at v4 is drift
+    dropped = {k: v for k, v in meta.items() if k != "comm_topology"}
+    errs = schema_mod.validate_history_records([dropped])
+    assert any("comm_topology" in e for e in errs), errs
+    # a v3 header without the field stays valid (its version's contract)
+    v3 = dict(dropped, schema_version=3)
+    assert schema_mod.validate_history_records([v3]) == []
+    # the drift also fails through the file validator (the gate's path)
+    p = tmp_path / "drift.jsonl"
+    p.write_text(json.dumps(dropped) + "\n")
+    errors, _ = schema_mod.validate_history_file(str(p))
+    assert any("comm_topology" in e for e in errors)
+
+
 def test_inspect_cli_validates_and_summarizes(mesh, tmp_path):
     """tools/tpuddp_inspect.py end to end: --validate accepts a real run's
     history, the summary renders, and a corrupted stream is refused."""

@@ -30,7 +30,16 @@ fields (host_stall_ms / inflight_depth / staging_queue_depth), bitwise-equal
 checkpoints leaf for leaf, and byte-identical step HLO — the async pipeline's
 "zero semantic cost" contract, enforced every gate run.
 
-Serving gate (after the pipeline gate): ``tools/loadgen.py --quick`` stands the continuous-
+Compression-matrix gate (after the pipeline gate): dryrun trainings across
+the comm hook x topology grid (none/bf16_ef/int8_ef/topk_ef x
+flat/hierarchical) must each produce a schema-valid history whose run_meta
+carries the comm accounting; the quantized/sparse hooks must show their
+acceptance byte cuts (>= 70% / >= 85%) against the header's own f32
+baseline, final-epoch losses must sit within the documented per-hook parity
+bound of the uncompressed run, and hierarchical rows must report inter-host
+bytes below the flat total.
+
+Serving gate (after the comm-matrix gate): ``tools/loadgen.py --quick`` stands the continuous-
 batching engine up on the CPU mesh (2 replicas, 2 tenants, ~170 requests
 across a closed-loop calibration + 3 offered-load points) and both emitted
 artifacts — the engine's ``history.jsonl`` (run_meta + serving_stats +
@@ -215,6 +224,107 @@ def _elastic_gate(env) -> int:
     return 0
 
 
+def _comm_matrix_gate(env) -> int:
+    """Compression-matrix leg (ISSUE 9): dryrun trainings across the hook x
+    topology grid (none/bf16_ef/int8_ef/topk_ef x flat/hierarchical), each
+    producing a history.jsonl that must (a) validate against the typed
+    schema, (b) carry the comm accounting fields in its run_meta header,
+    (c) show the acceptance byte cuts for the quantized/sparse hooks
+    (int8_ef >= 70%, topk_ef >= 85% vs the header's own f32 baseline), and
+    (d) finish with a final-epoch train loss within the documented per-hook
+    parity bound of the uncompressed flat run
+    (tpuddp.parallel.comm.loss_parity_tol). Hierarchical rows must also
+    report inter-host bytes BELOW the flat run's total — the topology's
+    reason to exist, enforced every gate run."""
+    import json
+
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    worker = os.path.join(REPO, "tests", "_chaos_train_worker.py")
+    sys.path.insert(0, REPO)
+    from tpuddp.parallel.comm import loss_parity_tol
+
+    with tempfile.TemporaryDirectory(prefix="tpuddp_comm_gate_") as tmp:
+        base_env = dict(env)
+        base_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        results = {}
+        for hook in ("none", "bf16_ef", "int8_ef", "topk_ef"):
+            for topology in ("flat", "hierarchical"):
+                out_dir = os.path.join(tmp, f"{hook}_{topology}")
+                os.makedirs(out_dir)
+                worker_env = dict(base_env)
+                worker_env["TPUDDP_CHAOS_TRAINING"] = json.dumps({
+                    "comm_hook": hook, "comm_topology": topology,
+                    "num_epochs": 3,
+                })
+                rc = subprocess.call(
+                    [sys.executable, "-u", worker, out_dir, "3"],
+                    cwd=REPO, env=worker_env,
+                )
+                if rc != 0:
+                    print(f"comm gate: {hook}/{topology} dryrun exited {rc}",
+                          file=sys.stderr)
+                    return rc or 1
+                history = os.path.join(out_dir, "history.jsonl")
+                rc = subprocess.call(
+                    [sys.executable, inspect, "--validate", history],
+                    cwd=REPO, env=env,
+                )
+                if rc != 0:
+                    print(f"comm gate: {hook}/{topology} history failed "
+                          "validation", file=sys.stderr)
+                    return rc
+                with open(history) as f:
+                    records = [json.loads(l) for l in f if l.strip()]
+                meta = next(r for r in records if r["type"] == "run_meta")
+                epochs = [r for r in records if r["type"] == "epoch"]
+                if meta.get("comm_topology") != topology:
+                    print(f"comm gate: {hook}/{topology} header records "
+                          f"topology {meta.get('comm_topology')!r}",
+                          file=sys.stderr)
+                    return 1
+                results[(hook, topology)] = {
+                    "meta": meta, "final_loss": epochs[-1]["train_loss"],
+                }
+        base = results[("none", "flat")]
+        f32 = base["meta"]["grad_comm_bytes_per_update_f32"]
+        for hook, floor in (("int8_ef", 0.70), ("topk_ef", 0.85)):
+            per = results[(hook, "flat")]["meta"]["grad_comm_bytes_per_update"]
+            cut = 1 - per / f32
+            if cut < floor:
+                print(f"comm gate: {hook} byte cut {cut * 100:.1f}% is under "
+                      f"the {floor * 100:.0f}% floor", file=sys.stderr)
+                return 1
+        for (hook, topology), row in results.items():
+            tol = loss_parity_tol(hook, base["final_loss"])
+            if abs(row["final_loss"] - base["final_loss"]) > tol:
+                print(
+                    f"comm gate: {hook}/{topology} final-epoch loss "
+                    f"{row['final_loss']:.4f} diverged from uncompressed "
+                    f"{base['final_loss']:.4f} (documented tol {tol:.4f})",
+                    file=sys.stderr,
+                )
+                return 1
+            if topology == "hierarchical":
+                inter = row["meta"]["grad_comm_bytes_inter_host"]
+                flat_total = results[(hook, "flat")]["meta"][
+                    "grad_comm_bytes_per_update"
+                ]
+                if inter >= flat_total:
+                    print(
+                        f"comm gate: {hook} hierarchical inter-host bytes "
+                        f"{inter} not below the flat total {flat_total}",
+                        file=sys.stderr,
+                    )
+                    return 1
+        print("comm gate: byte cuts + loss parity + hierarchical hop split "
+              "verified across the hook x topology matrix")
+    return 0
+
+
 def _pipeline_gate(env) -> int:
     """Async-pipeline leg (ISSUE 8): a depth-2 pipelined dryrun must produce
     a schema-valid history whose step_stats windows carry the occupancy
@@ -326,6 +436,9 @@ def main(argv=None):
     if rc != 0:
         return rc
     rc = _pipeline_gate(env)
+    if rc != 0:
+        return rc
+    rc = _comm_matrix_gate(env)
     if rc != 0:
         return rc
     rc = _serving_gate(env)

@@ -109,8 +109,8 @@ def summarize_history(path: str) -> None:
         for k in (
             "api", "model", "dataset", "config_hash", "mesh_shape",
             "world_size", "process_count", "device_kind", "jax_version",
-            "tpuddp_version", "comm_hook", "scan_steps", "grad_accumulation",
-            "step_stats_every",
+            "tpuddp_version", "comm_hook", "comm_topology", "comm_density",
+            "scan_steps", "grad_accumulation", "step_stats_every",
             # serving run_meta fields (api == "serving")
             "num_replicas", "max_batch_size", "max_queue_depth",
             "per_tenant_quota", "batch_timeout_ms", "buckets", "input_shape",
@@ -204,10 +204,18 @@ def summarize_history(path: str) -> None:
             saved = 1.0 - per / base
             line = (f"\ngrad comm: {per:,} B/update on the wire vs {base:,} B "
                     f"uncompressed ({saved * 100:.1f}% saved"
-                    f", hook {m.get('comm_hook')})")
+                    f", hook {m.get('comm_hook')}"
+                    f", topology {m.get('comm_topology') or 'flat'})")
             if total is not None:
                 line += f"; {total:,} B total this run"
             print(line)
+            # hierarchical hop split (schema v4): the compressed inter-host
+            # share vs the f32 intra-host (ICI) traffic per update
+            inter = m.get("grad_comm_bytes_inter_host")
+            intra = m.get("grad_comm_bytes_intra_host")
+            if inter is not None and intra:
+                print(f"  hop split: {inter:,} B inter-host (compressed) + "
+                      f"{intra:,} B intra-host (f32 ICI) per update")
 
     if events:
         print(f"\nevents ({len(events)}):")
@@ -229,6 +237,35 @@ def summarize_bench(path: str) -> None:
           f"(vs_baseline {payload.get('vs_baseline')} over "
           f"{payload.get('vs_baseline_basis')})")
     configs = payload.get("configs", {})
+    if any(
+        isinstance(r, dict) and "comm_topology" in r for r in configs.values()
+    ):
+        # comm-matrix rows (bench.py --comm): hook x topology A/B with the
+        # per-row wire-byte accounting and the loss-parity evidence
+        rows = []
+        for name, r in configs.items():
+            base = r.get("grad_comm_bytes_per_step_f32")
+            per = r.get("grad_comm_bytes_per_step")
+            cut = (
+                f"{(1 - per / base) * 100:.1f}%"
+                if per is not None and base else "-"
+            )
+            rows.append([
+                name,
+                str(r.get("comm_hook", "-")),
+                str(r.get("comm_topology", "-")),
+                _fmt(r.get("samples_per_sec_per_chip"), 0),
+                _fmt(r.get("ms_per_step"), 2),
+                str(per if per is not None else "-"),
+                str(r.get("grad_comm_bytes_inter_host", "-")),
+                cut,
+                _fmt(r.get("final_loss")),
+            ])
+        _print_table(rows, [
+            "config", "hook", "topo", "sps/chip", "ms", "wire B/step",
+            "interB", "cut", "loss",
+        ])
+        return
     if any(isinstance(r, dict) and "offered_rps" in r for r in configs.values()):
         # serving curve rows (tools/loadgen.py): offered-vs-achieved
         # throughput with client-side latency percentiles

@@ -449,7 +449,16 @@ class _FlatShardedUpdate(optim_lib.Optimizer):
 
         g_vec = wsc(_tree_to_vec(grads, self.spec), self._sharded)
         p_vec = _tree_to_vec(params, self.spec)
-        new_p_vec, new_os = self.inner.update(g_vec, opt_state, p_vec)
+        update_flat = getattr(self.inner, "update_flat", None)
+        if update_flat is not None:
+            # LARS/LAMB: per-layer trust ratios over the spec's leaf
+            # boundaries — the full vector is logically in hand here (XLA
+            # partitions the segment sums), so no explicit collective
+            new_p_vec, new_os = update_flat(
+                g_vec, opt_state, p_vec, spec=self.spec
+            )
+        else:
+            new_p_vec, new_os = self.inner.update(g_vec, opt_state, p_vec)
         # pin the state sharded (stable layout across steps/donation) and the
         # params replicated (the all-gather point)
         new_os = jax.tree_util.tree_map(
@@ -766,10 +775,16 @@ class PreparedModel:
     def _comm_hook_name(self) -> str:
         return getattr(self.accelerator, "comm_hook", "none")
 
+    def _comm_density(self) -> float:
+        from tpuddp.parallel.comm import DEFAULT_TOPK_DENSITY
+
+        return getattr(self.accelerator, "topk_density", DEFAULT_TOPK_DENSITY)
+
     def _get_fused_step(self, criterion, optimizer):
         key = (criterion, optimizer)
         if self._fused_step is None or self._fused_step[0] != key:
             hook = self._comm_hook_name()
+            density = self._comm_density()
             guard_on = self._guard_enabled()
             aug = getattr(self.accelerator, "augment", None)
 
@@ -798,7 +813,9 @@ class PreparedModel:
                     # quantize the aggregated gradient through the wire dtype
                     # with error feedback BEFORE the clip, matching the
                     # native step's reduce-then-clip order
-                    g, cs = comm_lib.local_quantize(grads, comm_state, hook)
+                    g, cs = comm_lib.local_quantize(
+                        grads, comm_state, hook, density=density
+                    )
                     g = self._maybe_clip(g)
                     new_params, new_opt = optimizer.update(g, opt_state, params)
                     return new_params, new_mstate, new_opt, cs
@@ -834,6 +851,7 @@ class PreparedModel:
         key = (criterion, optimizer, k)
         if key not in self._fused_scans:
             hook = self._comm_hook_name()
+            density = self._comm_density()
             guard_on = self._guard_enabled()
             aug = getattr(self.accelerator, "augment", None)
 
@@ -869,7 +887,9 @@ class PreparedModel:
                         # comm hook: same quantize -> clip -> update order as
                         # the single fused step; the error-feedback residual
                         # rides in the scan carry
-                        g, cs2 = comm_lib.local_quantize(grads, cs, hook)
+                        g, cs2 = comm_lib.local_quantize(
+                            grads, cs, hook, density=density
+                        )
                         g = self._maybe_clip(g)
                         new_p, new_os = optimizer.update(g, os_, p)
                         return new_p, new_ms, new_os, cs2
@@ -962,7 +982,10 @@ class PreparedOptimizer:
         else:
             self.opt_state = self.optimizer.init(model.params)
         hook = getattr(acc, "comm_hook", "none")
-        if hook == "bf16_ef" and self._comm_state is None:
+        if hook in comm_lib.EF_HOOKS and self._comm_state is None:
+            # every EF hook (bf16_ef/int8_ef/topk_ef) carries the same
+            # pytree-shaped residual on this path; scales are recomputed
+            # per step, never state
             self._comm_state = replicate(
                 acc.mesh, comm_lib.init_residual_tree(model._params)
             )
@@ -1123,13 +1146,16 @@ class PreparedOptimizer:
         if self._update is None:
             clip = getattr(self.model.accelerator, "clip_grad_norm", None)
             hook = self._comm_hook_name()
+            density = self.model._comm_density()
             guard_on = self.model._guard_enabled()
 
             def apply(grads, opt_state, params, comm_state, skipped, mstates, scale):
                 grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
 
                 def apply_all():
-                    g, cs = comm_lib.local_quantize(grads, comm_state, hook)
+                    g, cs = comm_lib.local_quantize(
+                        grads, comm_state, hook, density=density
+                    )
                     if clip is not None:
                         g, _ = optim_lib.clip_grad_norm_(g, clip)
                     new_params, new_opt = self.optimizer.update(
@@ -1283,6 +1309,8 @@ class Accelerator:
         weight_update_sharding: bool = False,
         comm_hook: str = "none",
         bucket_cap_mb: float = comm_lib.DEFAULT_BUCKET_CAP_MB,
+        comm_topology: str = "flat",
+        topk_density: float = comm_lib.DEFAULT_TOPK_DENSITY,
         guard=None,
         augment=None,
     ):
@@ -1364,6 +1392,24 @@ class Accelerator:
         self.gradient_accumulation_steps = max(1, int(gradient_accumulation_steps))
         self.weight_update_sharding = bool(weight_update_sharding)
         self.comm_hook = comm_lib.validate_hook(comm_hook)
+        # comm_topology is accepted for config parity with the explicit API,
+        # but only "flat" is implementable here: the managed path's gradient
+        # collective is inserted by XLA's partitioner, so there is no seam to
+        # express the intra-host/inter-host hop split through. The knob
+        # refuses rather than silently running flat under a hierarchical
+        # label — the byte accounting must never claim a topology that did
+        # not reach the wire.
+        comm_lib.validate_topology(comm_topology)
+        if comm_topology != "flat":
+            raise ValueError(
+                "comm_topology='hierarchical' needs the explicit API "
+                "(DistributedDataParallel / train_native.py, mode="
+                "'shard_map'): the managed path's collective is XLA-"
+                "inserted and cannot be hop-split"
+            )
+        self.comm_topology = comm_topology
+        self.topk_density = float(topk_density)
+        comm_lib.bucket_topk(1, self.topk_density)  # range-validate eagerly
         self.guard = guard_lib.resolve_guard(guard)
         self.augment = augment
         # typed event dicts from the last load_state's elastic reshard (a

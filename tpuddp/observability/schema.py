@@ -25,10 +25,14 @@ a gate instead of corrupting downstream consumers. The validators live here
 Version history: v1 introduced the envelope and the four training record
 types; v2 added ``serving_stats``; v3 added the async-pipeline occupancy
 fields to ``step_stats`` (``host_stall_ms``, ``inflight_depth``,
-``staging_queue_depth`` — tpuddp/training/pipeline.py). Readers accept every
+``staging_queue_depth`` — tpuddp/training/pipeline.py); v4 added
+``comm_topology`` to ``run_meta`` (the comm-compression-v2 topology knob —
+flat vs hierarchical multi-hop reduction, parallel/comm.py; the header also
+gained the non-required ``comm_density`` / ``grad_comm_bytes_inter_host`` /
+``grad_comm_bytes_intra_host`` accounting fields). Readers accept every
 version up to their own ``SCHEMA_VERSION`` and reject newer files; the
 per-version required-field sets apply at the version each record CARRIES, so
-a v2 history (no occupancy fields) stays valid under a v3 reader.
+a v2 history (no occupancy fields) stays valid under a v4 reader.
 """
 
 from __future__ import annotations
@@ -38,7 +42,7 @@ import hashlib
 import json
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 RECORD_TYPES = ("run_meta", "epoch", "step_stats", "event", "serving_stats")
 
@@ -97,15 +101,22 @@ _REQUIRED = {
     ),
 }
 
-# Fields additionally required of records stamped at schema_version >= 3:
-# the async pipeline's occupancy accounting. Applied at the version a record
-# CARRIES (older histories keep validating under newer readers).
-_REQUIRED_SINCE_V3 = {
-    "step_stats": (
-        "host_stall_ms",
-        "inflight_depth",
-        "staging_queue_depth",
-    ),
+# Fields additionally required of records stamped at schema_version >= N:
+# applied at the version a record CARRIES (older histories keep validating
+# under newer readers). v3: the async pipeline's occupancy accounting.
+# v4: the gradient-reduction topology knob in the header (comm compression
+# v2 — a run_meta without it cannot say which wire its comm bytes crossed).
+_REQUIRED_SINCE = {
+    3: {
+        "step_stats": (
+            "host_stall_ms",
+            "inflight_depth",
+            "staging_queue_depth",
+        ),
+    },
+    4: {
+        "run_meta": ("comm_topology",),
+    },
 }
 
 def stamp(record_type: str, record: dict) -> dict:
@@ -133,6 +144,7 @@ def make_run_meta(
     mesh=None,
     world_size: Optional[int] = None,
     comm_hook: Optional[str] = None,
+    comm_topology: Optional[str] = None,
     guard=None,
     extra: Optional[dict] = None,
 ) -> dict:
@@ -171,6 +183,9 @@ def make_run_meta(
         "mesh_shape": mesh_shape,
         "device_kind": device_kind,
         "comm_hook": comm_hook,
+        # required since schema v4: which wire topology the comm bytes
+        # crossed (null = no comm configured, e.g. serving headers)
+        "comm_topology": comm_topology,
         "guard": guard,
     }
     if extra:
@@ -199,8 +214,10 @@ def validate_record(record, index: int = 0) -> List[str]:
             f"{SCHEMA_VERSION}"
         )
     required = list(_REQUIRED[rtype])
-    if isinstance(version, int) and version >= 3:
-        required += list(_REQUIRED_SINCE_V3.get(rtype, ()))
+    if isinstance(version, int):
+        for since, extra in _REQUIRED_SINCE.items():
+            if version >= since:
+                required += list(extra.get(rtype, ()))
     missing = [k for k in required if k not in record]
     if missing:
         errors.append(f"{where} ({rtype}): missing required field(s) {missing}")

@@ -97,6 +97,38 @@ def bucketed_psum(vec, buckets, wire_dtype, axis_name: Optional[str] = DATA_AXIS
     return jnp.concatenate(parts)
 
 
+def allgather_dequant_sum(q, scale, axis_name):
+    """Cross-replica SUM of per-replica int8-quantized payloads (the int8_ef
+    exchange, parallel/comm.py): every replica's ``q`` (int8 values) and
+    ``scale`` (its f32 max-abs scale) are all-gathered — the collective's
+    operands ARE the compressed payload, the wire carries int8 + one scalar
+    per replica — and each replica dequantizes and sums locally. Per-replica
+    scales make a direct psum meaningless (summing int8 codes across
+    different scales is not a sum of gradients), which is why torch's
+    ``quantization_pertensor_hook`` takes the same all-gather shape."""
+    ag_q = lax.all_gather(q, axis_name)  # (world, n) int8
+    ag_s = lax.all_gather(scale, axis_name)  # (world,) f32
+    return jnp.sum(
+        ag_q.astype(jnp.float32) * ag_s[:, None].astype(jnp.float32), axis=0
+    )
+
+
+def allgather_topk_sum(idx, q, scale, n: int, axis_name):
+    """Cross-replica SUM of per-replica top-k sparse payloads (the topk_ef
+    exchange): all-gather the int32 indices + int8 values + f32 scale, then
+    scatter-add every replica's dequantized contribution into a dense (n,)
+    f32 vector — as ONE flattened scatter-add (duplicate indices across
+    replicas accumulate by scatter-add semantics), so the program stays
+    O(1) ops regardless of world size."""
+    ag_i = lax.all_gather(idx, axis_name)  # (world, k) int32
+    ag_q = lax.all_gather(q, axis_name)  # (world, k) int8
+    ag_s = lax.all_gather(scale, axis_name)  # (world,) f32
+    vals = ag_q.astype(jnp.float32) * ag_s[:, None].astype(jnp.float32)
+    return jnp.zeros((n,), jnp.float32).at[ag_i.reshape(-1)].add(
+        vals.reshape(-1)
+    )
+
+
 def psum_scatter_compressed(vec, wire_dtype, axis_name: str = DATA_AXIS):
     """Compressed reduce-scatter of a flat vector (the comm hooks' weight-
     update-sharding composition): the whole vector is cast to ``wire_dtype``

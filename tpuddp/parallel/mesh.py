@@ -20,6 +20,26 @@ from tpuddp.parallel import backend as _backend
 
 DATA_AXIS = "data"
 
+# The factored data mesh (comm_topology="hierarchical", parallel/comm.py):
+# the SAME replica set, with the axis split ("host", "local") so collectives
+# can address the intra-host (ICI) and inter-host (DCN) hops separately —
+# outer axis first, so consecutive local devices stay adjacent in the mesh.
+HOST_AXIS = "host"
+LOCAL_AXIS = "local"
+
+
+def data_axes(mesh: "Mesh"):
+    """The axis name(s) forming ``mesh``'s data-parallel dimension: the flat
+    ``"data"`` axis when present, else the full factored axis tuple (the
+    hierarchical ``("host", "local")`` split). Every mesh tpuddp builds is
+    data-parallel over ALL its axes, so the tuple is the whole name list;
+    ``jax.lax`` collectives, ``PartitionSpec`` entries, and ``axis_index``
+    all accept the tuple wherever the flat name went."""
+    names = tuple(mesh.axis_names)
+    if DATA_AXIS in names:
+        return DATA_AXIS
+    return names if len(names) > 1 else names[0]
+
 
 def local_mesh_devices(
     world_size: Optional[int] = None, backend: Optional[str] = None
@@ -56,6 +76,39 @@ def data_mesh(world_size: Optional[int] = None, backend: Optional[str] = None) -
     return make_mesh(local_mesh_devices(world_size, backend))
 
 
+def hierarchical_mesh(
+    world_size: Optional[int] = None,
+    hosts: Optional[int] = None,
+    backend: Optional[str] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """The factored ``("host", "local")`` data mesh for
+    ``comm_topology="hierarchical"``: the same replica set as
+    :func:`data_mesh`, with the axis split so the comm hooks can run the
+    intra-host f32 reduce-scatter / compressed inter-host exchange /
+    all-gather pipeline (parallel/comm.py ``reduce_hierarchical``).
+
+    ``hosts`` (the outer-axis size) defaults to ``jax.process_count()`` on a
+    real pod; on a single process (the CPU test rung, or one multi-chip
+    host) it defaults to 2 — a SIMULATED host split that keeps the factored
+    collectives and the intra/inter byte accounting testable without DCN.
+    The world must factor: ``hosts`` has to divide it."""
+    if devices is None:
+        devices = local_mesh_devices(world_size, backend)
+    world = len(devices)
+    if hosts is None:
+        hosts = jax.process_count() if jax.process_count() > 1 else 2
+    hosts = int(hosts)
+    if hosts < 2 or world % hosts:
+        raise ValueError(
+            f"comm_topology='hierarchical' needs a factorable world: "
+            f"{hosts} host group(s) do not tile {world} device(s); pick a "
+            "world size divisible by the host count (or >= 2 devices on the "
+            "simulated single-host split)"
+        )
+    return make_mesh(devices, axes={HOST_AXIS: hosts, LOCAL_AXIS: world // hosts})
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     """Sharding for parameters/optimizer state: replicated on every device
     (the DDP contract: replica-identical params, multi-GPU-training-torch.py:245)."""
@@ -71,8 +124,10 @@ def replicate(mesh: Mesh, tree):
 
 
 def data_sharded(mesh: Mesh, ndim: int = 1) -> NamedSharding:
-    """Sharding for a batch: leading axis split over the "data" mesh axis."""
-    spec = P(DATA_AXIS, *([None] * (ndim - 1))) if ndim > 1 else P(DATA_AXIS)
+    """Sharding for a batch: leading axis split over the data mesh axis
+    (the factored axis tuple on a hierarchical mesh)."""
+    axis = data_axes(mesh)
+    spec = P(axis, *([None] * (ndim - 1))) if ndim > 1 else P(axis)
     return NamedSharding(mesh, spec)
 
 
@@ -84,8 +139,10 @@ def shard_batch(mesh: Mesh, batch):
     loaded) and the global array is assembled across hosts — the TPU-native
     replacement for N dataloaders feeding N processes.
     """
+    axis = data_axes(mesh)
+
     def _put(x):
-        sharding = NamedSharding(mesh, P(DATA_AXIS, *([None] * (x.ndim - 1))))
+        sharding = NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))
         if (
             isinstance(x, jax.Array)
             and x.sharding.is_equivalent_to(sharding, x.ndim)

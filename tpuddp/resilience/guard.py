@@ -209,6 +209,10 @@ def _audit_program(mesh):
     """The compiled fingerprint-and-compare pass for ``mesh`` (cached per
     mesh; jax.jit then caches per parameter tree structure, so repeated
     audits on the same model never recompile)."""
+    from tpuddp.parallel.mesh import data_axes
+
+    axis = data_axes(mesh)  # the flat "data" axis, or the factored
+    # ("host", "local") tuple on a hierarchical comm-topology mesh
 
     def check(tree):
         fp = jax.tree_util.tree_map(_leaf_fingerprint, tree)
@@ -216,7 +220,7 @@ def _audit_program(mesh):
         # the subtraction into NaN != 0 — a non-finite parameter tree is
         # reported too (it is never a state worth training on).
         return jax.tree_util.tree_map(
-            lambda v: lax.pmax(v, DATA_AXIS) - lax.pmin(v, DATA_AXIS), fp
+            lambda v: lax.pmax(v, axis) - lax.pmin(v, axis), fp
         )
 
     return jax.jit(

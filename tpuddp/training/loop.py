@@ -277,6 +277,16 @@ def run_training_loop(
         "grad_comm_bytes_per_update_f32": getattr(
             ddp, "grad_comm_bytes_per_step_f32", None
         ),
+        # comm compression v2 accounting: which wire topology the bytes
+        # crossed, the top-k density, and the intra/inter-host hop split
+        # (the hierarchical topology's whole point — parallel/comm.py)
+        "comm_density": getattr(ddp, "topk_density", None),
+        "grad_comm_bytes_inter_host": getattr(
+            ddp, "grad_comm_bytes_inter_host", None
+        ),
+        "grad_comm_bytes_intra_host": getattr(
+            ddp, "grad_comm_bytes_intra_host", None
+        ),
         **(run_meta or {}),
     }
     topo_change = next(
@@ -290,6 +300,7 @@ def run_training_loop(
         mesh=getattr(ddp, "mesh", None),
         world_size=getattr(ddp, "world_size", None),
         comm_hook=getattr(ddp, "comm_hook", None),
+        comm_topology=getattr(ddp, "comm_topology", "flat"),
         guard=guard_cfg,
         extra=meta_extra,
     ))

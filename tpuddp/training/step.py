@@ -42,7 +42,7 @@ from tpuddp.nn.core import Context
 from tpuddp.parallel import collectives as col
 from tpuddp.resilience import guard as guard_lib
 from tpuddp.utils.compat import shard_map
-from tpuddp.parallel.mesh import DATA_AXIS, data_sharded, replicated
+from tpuddp.parallel.mesh import DATA_AXIS, data_axes, data_sharded, replicated
 from tpuddp.seeding import fold_in_axis_index
 from tpuddp.training.train_state import TrainState
 
@@ -96,36 +96,38 @@ def _vec_to_tree(vec, spec: FlatParamSpec):
     return jax.tree_util.tree_unflatten(spec.treedef, leaves)
 
 
-def sharded_state_spec(opt_state_template, spec: FlatParamSpec, comm=None):
+def sharded_state_spec(opt_state_template, spec: FlatParamSpec, comm=None,
+                       axis=DATA_AXIS):
     """The shard_map PartitionSpec pytree for a TrainState whose optimizer
     moment vectors are sharded over the data axis (weight-update sharding):
-    every (total,)-sized 1-D leaf of the optimizer state is P(DATA_AXIS),
+    every (total,)-sized 1-D leaf of the optimizer state is P(axis),
     everything else replicated. ``comm`` (a GradComm with an error-feedback
     residual) additionally marks ``comm_state`` sharded — the residual is
-    per-replica local state, laid out like the moment shards."""
+    per-replica local state, laid out like the moment shards. ``axis`` is
+    the data axis name (a tuple on the factored hierarchical mesh)."""
     def leaf_spec(l):
         if getattr(l, "ndim", None) == 1 and l.shape[0] == spec.total:
-            return P(DATA_AXIS)
+            return P(axis)
         return P()
 
     opt_spec = jax.tree_util.tree_map(leaf_spec, opt_state_template)
     return TrainState(
         params=P(), model_state=P(), opt_state=opt_spec, step=P(), rng=P(),
         comm_state=(
-            P(DATA_AXIS) if comm is not None and comm.needs_residual else P()
+            P(axis) if comm is not None and comm.needs_residual else P()
         ),
         skipped_steps=P(),  # guard counters replicate (P() is a safe prefix
         # for the empty subtree when the guard is off)
     )
 
 
-def comm_state_spec():
+def comm_state_spec(axis=DATA_AXIS):
     """The shard_map PartitionSpec pytree for a TrainState whose ONLY sharded
-    member is the per-replica comm-hook residual (comm_hook="bf16_ef" without
+    member is the per-replica comm-hook residual (an EF hook without
     weight-update sharding): everything replicated except ``comm_state``."""
     return TrainState(
         params=P(), model_state=P(), opt_state=P(), step=P(), rng=P(),
-        comm_state=P(DATA_AXIS), skipped_steps=P(),
+        comm_state=P(axis), skipped_steps=P(),
     )
 
 
@@ -227,20 +229,25 @@ def _make_grad_core(
 
 def _make_update_fn(
     optimizer,
-    axis_name: Optional[str],
+    axis_name,
     clip_grad_norm: Optional[float],
     wus_spec: Optional[FlatParamSpec],
     comm=None,
     guard: bool = False,
+    hier: Optional[Tuple[str, str]] = None,
 ):
     """The optimizer half of the train step: replica-local mean gradients in,
     ``(new_params, new_opt_state, new_comm_state, new_skipped)`` out. Owns
-    the cross-replica exchange (pmean, a compressed bucketed psum when a
+    the cross-replica exchange (pmean, a compressed bucketed exchange when a
     comm hook is configured, or reduce-scatter/all-gather under
     weight-update sharding) and the clip-after-aggregate. ``comm`` is a
     :class:`tpuddp.parallel.comm.GradComm` plan (None or hook "none" keeps
     the legacy full-precision path byte-identical); ``comm_state`` threads
-    the bf16_ef error-feedback residual through the step.
+    the error-feedback residual through the step. ``hier=(inner, outer)``
+    routes the exchange through the hierarchical multi-hop reduction
+    (``comm_topology="hierarchical"``: intra-host f32 reduce-scatter over
+    ``inner``, compressed inter-host exchange over ``outer``, all-gather —
+    requires a ``comm`` plan, which may carry hook "none").
 
     ``guard=True`` arms the non-finite gradient firewall
     (resilience/guard.py): ONE fused finiteness reduction over the
@@ -322,9 +329,19 @@ def _make_update_fn(
                 p_shard = jax.lax.dynamic_slice(
                     p_vec, (idx * shard_n,), (shard_n,)
                 )
-                new_p_shard, new_opt_state = optimizer.update(
-                    g, opt_state, p_shard
-                )
+                update_flat = getattr(optimizer, "update_flat", None)
+                if update_flat is not None:
+                    # layer-boundary-aware flat update (LARS/LAMB trust
+                    # ratios over the spec's leaf offsets; per-layer norms
+                    # psum across the axis since shards straddle layers)
+                    new_p_shard, new_opt_state = update_flat(
+                        g, opt_state, p_shard, spec=wus_spec,
+                        axis_name=axis_name, shard_index=idx,
+                    )
+                else:
+                    new_p_shard, new_opt_state = optimizer.update(
+                        g, opt_state, p_shard
+                    )
                 new_p_vec = jax.lax.all_gather(
                     new_p_shard, axis_name, tiled=True
                 )
@@ -353,11 +370,18 @@ def _make_update_fn(
             # backward — `grads` IS the global-batch f32 gradient, checked
             # here BEFORE the hook quantizes it (the f32-payload contract)
             ok = guard_lib.tree_all_finite(grads)
-        if comm is not None and comm.compressed:
+        if hier is not None and comm is not None:
+            # hierarchical multi-hop reduction over the factored data mesh:
+            # intra-host f32 reduce-scatter -> compressed inter-host
+            # exchange -> all-gather (comm.reduce_hierarchical)
+            agg_grads, new_comm = comm.reduce_hierarchical(
+                grads, comm_state, hier[0], hier[1]
+            )
+        elif comm is not None and comm.compressed:
             # bucketed compressed allreduce (torch DDP comm-hook analog):
-            # flatten -> per-bucket bf16 psum -> f32 decompress -> mean.
-            # With axis_name=None (auto mode) this is the local quantization
-            # emulation — XLA's implicit psum already aggregated.
+            # flatten -> per-bucket compress -> collective -> f32 decompress
+            # -> mean. With axis_name=None (auto mode) this is the local
+            # quantization emulation — XLA's implicit psum already aggregated.
             agg_grads, new_comm = comm.reduce(grads, comm_state, axis_name)
         elif axis_name is not None:
             # THE DDP step: average gradients across replicas (reference
@@ -395,7 +419,7 @@ def _make_train_core(
     model,
     criterion,
     optimizer,
-    axis_name: Optional[str],
+    axis_name,
     sync_buffers: str,
     clip_grad_norm: Optional[float],
     augment: Optional[Callable],
@@ -403,6 +427,7 @@ def _make_train_core(
     wus_spec: Optional[FlatParamSpec] = None,
     comm=None,
     guard: bool = False,
+    hier: Optional[Tuple[str, str]] = None,
 ):
     _validate_sync_buffers(model, axis_name, sync_buffers)
     if wus_spec is not None and axis_name is None:
@@ -415,7 +440,8 @@ def _make_train_core(
         model, criterion, axis_name, sync_buffers, augment, remat
     )
     apply_update = _make_update_fn(
-        optimizer, axis_name, clip_grad_norm, wus_spec, comm=comm, guard=guard
+        optimizer, axis_name, clip_grad_norm, wus_spec, comm=comm, guard=guard,
+        hier=hier,
     )
 
     def core(state: TrainState, x, y, w):
@@ -485,30 +511,34 @@ def build_train_step(
     state_spec=None,
     comm=None,
     guard: bool = False,
+    hier: Optional[Tuple[str, str]] = None,
 ):
     """Compile the DP train step over ``mesh``. Returns
     ``step(state, (x, y, w)) -> (new_state, metrics)`` with donated state.
     ``wus_spec``/``state_spec`` (from :func:`make_flat_param_spec` /
     :func:`sharded_state_spec`) switch on weight-update sharding. ``comm``
     (a :class:`tpuddp.parallel.comm.GradComm`) switches the gradient
-    exchange to the bucketed compressed hook pipeline; a bf16_ef hook needs
-    a ``state_spec`` marking ``comm_state`` sharded (:func:`comm_state_spec`
-    or :func:`sharded_state_spec` with ``comm=``). ``guard=True`` arms the
+    exchange to the bucketed compressed hook pipeline; an error-feedback
+    hook needs a ``state_spec`` marking ``comm_state`` sharded
+    (:func:`comm_state_spec` or :func:`sharded_state_spec` with ``comm=``).
+    ``hier=(inner, outer)`` routes the exchange hierarchically over a
+    factored mesh (see :func:`_make_update_fn`). ``guard=True`` arms the
     non-finite gradient firewall (state must carry ``skipped_steps``
     counters; see resilience/guard.py); ``False`` lowers to the identical
     program as before the guard existed."""
     if mode == "shard_map":
+        axis = data_axes(mesh)
         st_spec = state_spec if state_spec is not None else P()
         core = _make_train_core(
-            model, criterion, optimizer, DATA_AXIS, sync_buffers,
+            model, criterion, optimizer, axis, sync_buffers,
             clip_grad_norm, augment, remat, wus_spec=wus_spec, comm=comm,
-            guard=guard,
+            guard=guard, hier=hier,
         )
         fn = shard_map(
             core,
             mesh=mesh,
-            in_specs=(st_spec, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-            out_specs=(st_spec, {"loss_sum": P(DATA_AXIS), "n": P(DATA_AXIS)}),
+            in_specs=(st_spec, P(axis), P(axis), P(axis)),
+            out_specs=(st_spec, {"loss_sum": P(axis), "n": P(axis)}),
             check_vma=False,
         )
         jitted = jax.jit(fn, donate_argnums=0)
@@ -549,6 +579,7 @@ def build_train_scan_step(
     grad_accumulation: int = 1,
     comm=None,
     guard: bool = False,
+    hier: Optional[Tuple[str, str]] = None,
 ):
     """Multi-step variant: runs K train steps per jit call via ``lax.scan``.
 
@@ -572,8 +603,9 @@ def build_train_scan_step(
     cycle length). K must be a multiple of A.
     """
     if mode == "shard_map":
-        axis_name, in_batch = DATA_AXIS, P(None, DATA_AXIS)
-        metric_spec = P(DATA_AXIS)
+        axis_name = data_axes(mesh)
+        in_batch = P(None, axis_name)
+        metric_spec = P(axis_name)
     elif mode == "auto":
         axis_name, in_batch = None, None
     else:
@@ -594,7 +626,7 @@ def build_train_scan_step(
         core = _make_train_core(
             model, criterion, optimizer, axis_name, sync_buffers,
             clip_grad_norm, augment, remat, wus_spec=wus_spec, comm=comm,
-            guard=guard,
+            guard=guard, hier=hier,
         )
 
         def multi(state: TrainState, xs, ys, ws):
@@ -612,7 +644,7 @@ def build_train_scan_step(
         )
         apply_update = _make_update_fn(
             optimizer, axis_name, clip_grad_norm, wus_spec, comm=comm,
-            guard=guard,
+            guard=guard, hier=hier,
         )
 
         def multi(state: TrainState, xs, ys, ws):
@@ -747,15 +779,16 @@ def build_eval_step(
     core never reads the optimizer state, but the input placement must
     match)."""
     if mode == "shard_map":
-        core = _make_eval_core(model, criterion, DATA_AXIS, transform)
+        axis = data_axes(mesh)
+        core = _make_eval_core(model, criterion, axis, transform)
         fn = shard_map(
             core,
             mesh=mesh,
             in_specs=(
                 state_spec if state_spec is not None else P(),
-                P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                P(axis), P(axis), P(axis),
             ),
-            out_specs={"loss_sum": P(DATA_AXIS), "correct": P(DATA_AXIS), "n": P(DATA_AXIS)},
+            out_specs={"loss_sum": P(axis), "correct": P(axis), "n": P(axis)},
             check_vma=False,
         )
         jitted = jax.jit(fn)
@@ -790,7 +823,8 @@ def build_eval_scan_step(
     per-batch dispatch-bound, reference warm loop
     multi-GPU-training-torch.py:136-153)."""
     if mode == "shard_map":
-        core = _make_eval_core(model, criterion, DATA_AXIS, transform)
+        axis = data_axes(mesh)
+        core = _make_eval_core(model, criterion, axis, transform)
     elif mode == "auto":
         core = _make_eval_core(model, criterion, None, transform)
     else:
@@ -804,7 +838,7 @@ def build_eval_scan_step(
         return jax.tree_util.tree_map(lambda a: jnp.sum(a, axis=0), stacked)
 
     if mode == "shard_map":
-        in_batch = P(None, DATA_AXIS)
+        in_batch = P(None, axis)
         fn = shard_map(
             multi,
             mesh=mesh,
@@ -813,9 +847,9 @@ def build_eval_scan_step(
                 in_batch, in_batch, in_batch,
             ),
             out_specs={
-                "loss_sum": P(DATA_AXIS),
-                "correct": P(DATA_AXIS),
-                "n": P(DATA_AXIS),
+                "loss_sum": P(axis),
+                "correct": P(axis),
+                "n": P(axis),
             },
             check_vma=False,
         )

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpuddp import config as cfg_lib
-from tpuddp import nn, optim
+from tpuddp import nn
 from tpuddp.accelerate import Accelerator
 from tpuddp.resilience.guard import ReplicaDesync
 from tpuddp.resilience.preemption import (
@@ -266,6 +266,18 @@ def run_training_loop(
         "num_epochs": num_epochs,
         "step_stats_every": int(step_stats_every or 0),
         "pipeline": pipeline.as_dict(),
+        # comm compression v2 accounting: the managed emulation's wire is the
+        # XLA-inserted f32 psum; density is provenance (it shapes the
+        # quantization). The per-update byte counter exists only once the
+        # lazily-initialized model/optimizer have materialized (a resumed
+        # run); a fresh run learns it at the first step, so the header omits
+        # the key rather than recording a null that reads as "no bytes".
+        "comm_density": getattr(accelerator, "topk_density", None),
+        **(
+            {"grad_comm_bytes_per_update": optimizer.grad_comm_bytes_per_step}
+            if getattr(optimizer, "grad_comm_bytes_per_step", None) is not None
+            else {}
+        ),
         **(run_meta or {}),
     }
     topo_change = next(
@@ -277,6 +289,7 @@ def run_training_loop(
     metrics_writer.write(make_run_meta(
         mesh=getattr(accelerator, "mesh", None),
         comm_hook=getattr(accelerator, "comm_hook", None),
+        comm_topology=getattr(accelerator, "comm_topology", "flat"),
         guard=guard_cfg,
         extra=meta_extra,
     ))
@@ -567,9 +580,13 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         gradient_accumulation_steps=accum,
         weight_update_sharding=bool(training.get("weight_update_sharding", False)),
         # gradient-comm hook (managed emulation; parallel/comm.py): same
-        # training.comm_hook knob as the native entrypoint
+        # training.comm_hook / comm_topology / topk_density knobs as the
+        # native entrypoint (hierarchical topology is explicit-path-only and
+        # refused here rather than silently run flat)
         comm_hook=str(training.get("comm_hook") or "none"),
         bucket_cap_mb=float(training.get("bucket_cap_mb") or 25),
+        comm_topology=str(training.get("comm_topology") or "flat"),
+        topk_density=float(training.get("topk_density") or 0.1),
         # numerical guard (resilience/guard.py): non-finite-update firewall
         # in the fused/scan/accumulation programs + prepare-time desync audit
         guard=training.get("guard"),
@@ -581,10 +598,9 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
     model = load_model_for(training)
 
     criterion = nn.CrossEntropyLoss()
-    optimizer = optim.Adam(
-        lr=training["learning_rate"],
-        state_dtype=training.get("optimizer_state_dtype"),
-    )
+    # training.optimizer: adam default, lars/lamb/sgdw for large-batch runs —
+    # config.optimizer_from, the SAME factory the native entrypoint uses
+    optimizer = cfg_lib.optimizer_from(training)
 
     # prepare() wraps model/optimizer/train loader for the mesh backend
     # (reference :129-131); test_loader deliberately stays unprepared

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from tpuddp import config as cfg_lib
-from tpuddp import nn, observability as obs, optim, seeding
+from tpuddp import nn, observability as obs, seeding
 from tpuddp.data import (
     PrefetchLoader,
     ShardedDataLoader,
@@ -54,7 +54,15 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
     # across ranks (DistributedSampler contract) and independent of model seed.
     key, _base_seed = seeding.set_seed_based_on_rank(rank, training.get("seed"))
 
-    mesh = data_mesh(world_size)
+    # comm_topology: hierarchical factors the data mesh ("host", "local") so
+    # the comm hooks can split the intra-/inter-host hops (parallel/comm.py)
+    comm_topology = str(training.get("comm_topology") or "flat")
+    if comm_topology == "hierarchical":
+        from tpuddp.parallel.mesh import hierarchical_mesh
+
+        mesh = hierarchical_mesh(world_size)
+    else:
+        mesh = data_mesh(world_size)
 
     # Data + model (reference :237-238); synthetic fallback keeps the tutorial
     # runnable with no dataset staged (zero-egress environments).
@@ -107,14 +115,14 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
     if training.get("sync_bn"):
         nn.convert_sync_batchnorm(model)
 
-    # Loss + optimizer (reference :248-249). optimizer_state_dtype: bfloat16
+    # Loss + optimizer (reference :248-249). training.optimizer selects the
+    # update rule (adam default; lars/lamb for large-batch trust-ratio
+    # scaling, sgdw as their decay-only baseline — config.optimizer_from,
+    # shared with the managed entrypoint). optimizer_state_dtype: bfloat16
     # stores Adam m/v in bf16 (f32 math, f32 master params) — halves the
     # optimizer HBM traffic that dominates FC-heavy steps (BASELINE.md).
     criterion = nn.CrossEntropyLoss()
-    optimizer = optim.Adam(
-        lr=training["learning_rate"],
-        state_dtype=training.get("optimizer_state_dtype"),
-    )
+    optimizer = cfg_lib.optimizer_from(training)
 
     # The DDP wrap (reference :245): builds the shard_map'd pmean train step.
     # weight_update_sharding swaps the allreduce+replicated-update for
@@ -136,9 +144,13 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         # into the scan step — same knob name as the managed path
         grad_accumulation=int(training.get("gradient_accumulation_steps") or 1),
         # gradient-comm hook (torch DDP comm-hook analog, parallel/comm.py):
-        # bf16/bf16_ef halve the gradient interconnect bytes per step
+        # bf16/bf16_ef halve the gradient interconnect bytes per step;
+        # int8_ef cuts ~75%, topk_ef ~87.5% at density 0.1 (error-feedback
+        # residual carries what compression dropped)
         comm_hook=str(training.get("comm_hook") or "none"),
         bucket_cap_mb=float(training.get("bucket_cap_mb") or 25),
+        comm_topology=comm_topology,
+        topk_density=float(training.get("topk_density") or 0.1),
         # numerical guard (resilience/guard.py): non-finite-update firewall +
         # desync auditor + rollback-to-last-good; off (exact legacy step)
         # unless the training.guard block asks for it
