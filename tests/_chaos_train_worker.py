@@ -10,9 +10,12 @@ Usage: python _chaos_train_worker.py <out_dir> <num_epochs>
 ``$TPUDDP_CHAOS_TRAINING`` may hold a JSON object of training-config
 overrides (e.g. ``{"guard": {"max_consecutive_skips": 0}}``) so chaos
 scenarios can arm the numerical guard without a worker per knob.
-``$TPUDDP_WORLD_SIZE`` overrides the 4-device default world — the elastic
-chaos matrix (and the restart supervisor's shrink policy) resumes the same
-out_dir on a different world size through the v2 reshard path.
+``$TPUDDP_CHAOS_OBS`` does the same for the ``observability`` block (e.g.
+``{"exporter": true}`` to scrape a live chaos run); the defaults (flight
+recorder on, exporter off) apply otherwise. ``$TPUDDP_WORLD_SIZE``
+overrides the 4-device default world — the elastic chaos matrix (and the
+restart supervisor's shrink policy) resumes the same out_dir on a
+different world size through the v2 reshard path.
 """
 
 import json
@@ -41,9 +44,12 @@ TRAINING = {
     "synthetic_n": (256, 64),  # 8 train batch groups per epoch
 }
 TRAINING.update(json.loads(os.environ.get("TPUDDP_CHAOS_TRAINING") or "{}"))
+OBSERVABILITY = json.loads(os.environ.get("TPUDDP_CHAOS_OBS") or "null")
 
 run_ddp_training(
-    partial(basic_ddp_training_loop, training=TRAINING),
+    partial(
+        basic_ddp_training_loop, training=TRAINING, observability=OBSERVABILITY
+    ),
     world_size=world_size,
     save_dir=out_dir,
     optional_args={"set_epoch": True, "print_rand": False},

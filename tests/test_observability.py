@@ -632,3 +632,798 @@ def test_sigusr1_handler_installs_and_fires():
         deadline -= 1
     assert profiling_mod.consume_sigusr1_request() is True
     assert profiling_mod.consume_sigusr1_request() is False
+
+
+# ------------------------------------------------ ISSUE 10: live telemetry --
+
+
+def _scrape(port: int, path: str = "/metrics") -> str:
+    import urllib.request
+
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ).read().decode()
+
+
+def _prom_value(text: str, name: str, labels: str = ""):
+    needle = f"{name}{labels} " if labels else f"{name} "
+    for line in text.splitlines():
+        if line.startswith(needle):
+            return float(line.split()[-1])
+    return None
+
+
+def test_observability_config_resolution():
+    from tpuddp import config as cfg_lib
+
+    # defaults: exporter OFF, aggregation + flight recorder on
+    cfg = cfg_lib.resolve_observability(None)
+    assert cfg["exporter"] is False
+    assert cfg["aggregate"] is True and cfg["flight_recorder"] is True
+    # false turns the whole plane off
+    off = cfg_lib.resolve_observability(False)
+    assert not off["exporter"] and not off["aggregate"]
+    assert not off["flight_recorder"]
+    # the exporter dict shorthand expands to host/port knobs
+    cfg = cfg_lib.resolve_observability(
+        {"exporter": {"host": "0.0.0.0", "port": 9100}}
+    )
+    assert cfg["exporter"] is True
+    assert cfg["exporter_host"] == "0.0.0.0" and cfg["exporter_port"] == 9100
+    # unknown keys refused, both levels
+    with pytest.raises(ValueError, match="unknown observability key"):
+        cfg_lib.resolve_observability({"straggler_ration": 2.0})
+    with pytest.raises(ValueError, match="observability.exporter"):
+        cfg_lib.resolve_observability({"exporter": {"prot": 1}})
+
+
+def test_exporter_ephemeral_bind_and_endpoints(tmp_path):
+    """Port-0 binds ephemerally (two exporters coexist), the port file is
+    published and removed, and all three endpoints answer."""
+    from tpuddp.observability.exporter import (
+        MetricsExporter, PORT_FILENAME, counter,
+    )
+
+    a = MetricsExporter(port=0, run_dir=str(tmp_path)).start()
+    b = MetricsExporter(port=0).start()
+    try:
+        assert a.port and b.port and a.port != b.port
+        port_file = tmp_path / PORT_FILENAME
+        assert int(port_file.read_text()) == a.port
+        a.register_source("t", lambda: {"x_total": counter(3, "x")})
+        assert _prom_value(_scrape(a.port), "tpuddp_x_total") == 3
+        health = json.loads(_scrape(a.port, "/healthz"))
+        assert health["status"] == "ok" and health["uptime_s"] >= 0
+        snap = json.loads(_scrape(a.port, "/snapshot"))
+        assert snap["series"]["x_total"]["value"] == 3
+        with pytest.raises(Exception):  # 404 on unknown paths
+            _scrape(a.port, "/nope")
+        # a failing source is skipped, the scrape survives
+        def boom():
+            raise RuntimeError("broken feeder")
+        a.register_source("bad", boom)
+        assert "tpuddp_x_total 3" in _scrape(a.port)
+    finally:
+        a.stop()
+        b.stop()
+    assert not (tmp_path / PORT_FILENAME).exists()
+    # stop is idempotent
+    a.stop()
+
+
+def test_exporter_scrape_matches_recorder_state(monkeypatch):
+    """ISSUE 10 acceptance (training side): /metrics values equal the
+    recorder's last flushed window exactly — the live plane can never
+    disagree with history.jsonl beyond one window."""
+    import tpuddp.observability.recorder as rec_mod
+    from tpuddp.observability.exporter import MetricsExporter
+    from tpuddp.observability.telemetry import RunTelemetry
+
+    clock = {"t": 0.0}
+    monkeypatch.setattr(rec_mod.time, "perf_counter", lambda: clock["t"])
+    tel = RunTelemetry(writer=None, step_stats_every=4)
+    exporter = MetricsExporter(port=0).start()
+    try:
+        tel.attach_live(exporter=exporter)
+        tel.start_epoch(0)
+        for ms in (1, 2, 3, 4):  # one window of laps 1..4 ms
+            clock["t"] += ms / 1e3
+            tel.post_dispatch(1, 8)
+        tel.update_live(skipped_steps=2, train_loss=0.5)
+        text = _scrape(exporter.port)
+        win = tel.recorder.last_window
+        assert win is not None
+        assert _prom_value(text, "tpuddp_train_steps_total") == 4
+        assert _prom_value(text, "tpuddp_train_samples_total") == 32
+        assert _prom_value(
+            text, "tpuddp_step_time_ms", '{quantile="0.5"}'
+        ) == pytest.approx(win["step_time_ms_p50"])
+        assert _prom_value(
+            text, "tpuddp_step_time_ms", '{quantile="0.99"}'
+        ) == pytest.approx(win["step_time_ms_p99"])
+        assert _prom_value(
+            text, "tpuddp_train_samples_per_sec"
+        ) == pytest.approx(win["samples_per_sec"])
+        assert _prom_value(text, "tpuddp_skipped_steps") == 2
+        assert _prom_value(text, "tpuddp_train_loss") == 0.5
+    finally:
+        exporter.stop()
+        tel.finish()
+
+
+def test_loop_live_plane_on_records_port_and_hlo_identical(mesh, tmp_path):
+    """The whole plane on (exporter + flight + aggregation enabled) changes
+    ZERO device semantics: run_meta records the bound endpoint, the step
+    program lowers byte-identical to a never-telemetered build, and a clean
+    exit leaves no flight recording and no port file."""
+    ddp, (state, history) = small_run(
+        mesh, str(tmp_path), num_epochs=1, step_stats_every=2, n=256,
+        observability={"exporter": True, "exporter_port": 0},
+    )
+    records = read_history(tmp_path / "history.jsonl")
+    meta = records[0]
+    obs = meta["observability"]
+    assert obs["exporter"]["port"] > 0
+    assert obs["flight_recorder"] == {"capacity": 64}
+    assert obs["straggler_ratio"] == 1.5 and obs["straggler_windows"] == 3
+    assert schema_mod.validate_history_records(records) == []
+    # clean exit: endpoint torn down, no crash artifact
+    assert not (tmp_path / "exporter.port").exists()
+    assert not list(tmp_path.glob("flightrec_*.json"))
+
+    def lower_text(d, st):
+        b = d.shard((
+            np.zeros((64, 8, 8, 3), np.float32),
+            np.zeros((64,), np.int32),
+            np.ones((64,), np.float32),
+        ))
+        return jax.jit(lambda s, x: d.train_step(s, x)).lower(st, b).as_text()
+
+    fresh = DistributedDataParallel(
+        ToyMLP(hidden=(16,)), optim.Adam(1e-2), CrossEntropyLoss(), mesh=mesh
+    )
+    fresh_state = fresh.init_state(jax.random.key(0), jnp.zeros((1, 8, 8, 3)))
+    assert lower_text(ddp, fresh_state) == lower_text(fresh, fresh_state)
+
+
+def test_serving_engine_live_scrape_matches_stats(mesh, tmp_path):
+    """Serving acceptance: a live /metrics scrape during traffic reports the
+    engine's own counters and the LAST flushed serving_stats window; drain
+    tears the endpoint down."""
+    import urllib.error
+
+    from tpuddp.serving import ServingEngine
+
+    cfg = {
+        "model": "toy_mlp", "num_classes": 10, "input_shape": [8, 8, 3],
+        "checkpoint_dir": None, "checkpoint_prefix": "auto",
+        "num_replicas": 2, "max_batch_size": 8, "max_queue_depth": 64,
+        "per_tenant_quota": None, "batch_timeout_ms": 0.5,
+        "stats_window": 8, "unhealthy_after": 3, "seed": 0,
+    }
+    engine = ServingEngine.from_config(
+        cfg, out_dir=str(tmp_path),
+        observability={"exporter": True, "exporter_port": 0},
+    )
+    engine.start()
+    port = engine.exporter.port
+    try:
+        rng = np.random.RandomState(0)
+        results = [
+            engine.submit(f"tenant{i % 2}", rng.randn(2, 8, 8, 3).astype(np.float32))
+            for i in range(24)
+        ]
+        for r in results:
+            r.result(timeout=120)
+        text = _scrape(port)
+        assert _prom_value(text, "tpuddp_serving_completed_total") == 24
+        assert _prom_value(text, "tpuddp_serving_requests_total") == 24
+        assert _prom_value(text, "tpuddp_serving_replicas_healthy") == 2
+        win = engine.stats.last_window
+        assert win is not None  # 24 completed / window 8 -> windows flushed
+        assert _prom_value(
+            text, "tpuddp_serving_e2e_ms", '{quantile="0.5"}'
+        ) == pytest.approx(win["e2e_ms_p50"])
+        assert _prom_value(
+            text, "tpuddp_serving_throughput_rps"
+        ) == pytest.approx(win["throughput_rps"])
+        assert _prom_value(
+            text, "tpuddp_serving_tenant_completed_total", '{tenant="tenant0"}'
+        ) == 12
+        # and the flushed history agrees with the scrape (same record)
+        records = read_history(tmp_path / "history.jsonl")
+        flushed = [r for r in records if r["type"] == "serving_stats"]
+        assert flushed[-1]["e2e_ms_p50"] == win["e2e_ms_p50"]
+    finally:
+        engine.drain()
+    with pytest.raises(Exception):  # endpoint down after drain
+        _scrape(port, "/healthz")
+    errors, _ = schema_mod.validate_history_file(str(tmp_path / "history.jsonl"))
+    assert errors == []
+
+
+# ---------------------------------------------- shard channel + aggregator --
+
+
+def test_heartbeat_shard_channel_round_trip(tmp_path):
+    """The heartbeat file carries the telemetry shard on line 2; liveness
+    reads (line 1) are indifferent, and a torn JSON line is skipped with a
+    warning, never an exception."""
+    from tpuddp.observability import aggregate
+    from tpuddp.resilience import watchdog
+
+    shard = {"window_index": 3, "step_time_ms_p50": 1.5, "skipped_steps": 0}
+    aggregate.publish_shard(str(tmp_path), 1, shard)
+    assert watchdog.read_heartbeat(str(tmp_path), 1) is not None
+    assert aggregate.read_shard(str(tmp_path), 1) == shard
+    # payload-free beats still read as alive, shard None
+    watchdog.write_heartbeat(str(tmp_path), 2, now=123.0)
+    assert watchdog.read_heartbeat(str(tmp_path), 2) == 123.0
+    assert aggregate.read_shard(str(tmp_path), 2) is None
+    # a torn mid-write line: liveness survives, shard read returns None
+    with open(tmp_path / "hb_3", "w") as f:
+        f.write("456.0\n{\"window_index\": 9, \"step_time")  # torn
+    assert watchdog.read_heartbeat(str(tmp_path), 3) == 456.0
+    assert aggregate.read_shard(str(tmp_path), 3) is None
+    # absent peer
+    assert aggregate.read_shard(str(tmp_path), 7) is None
+
+
+def test_purge_stale_peers_preserves_live_shards(tmp_path):
+    """ISSUE 10 satellite: the elastic-resume purge removes ONLY the old
+    larger world's hb files — live peers' shard payloads survive."""
+    from tpuddp.observability import aggregate
+    from tpuddp.resilience import watchdog
+
+    for pid in range(4):
+        aggregate.publish_shard(
+            str(tmp_path), pid, {"window_index": pid, "step_time_ms_p50": 1.0}
+        )
+    removed = watchdog.purge_stale_peers(str(tmp_path), 2)
+    assert removed == 2
+    assert not os.path.exists(tmp_path / "hb_2")
+    assert not os.path.exists(tmp_path / "hb_3")
+    for pid in (0, 1):  # the live world keeps both liveness AND shards
+        assert watchdog.read_heartbeat(str(tmp_path), pid) is not None
+        assert aggregate.read_shard(str(tmp_path), pid)["window_index"] == pid
+
+
+def _shard_dir(tmp_path, p50s, window=1):
+    from tpuddp.observability import aggregate
+
+    for pid, p50 in enumerate(p50s):
+        aggregate.publish_shard(str(tmp_path), pid, {
+            "window_index": window, "epoch": 0, "step": window * 4,
+            "step_time_ms_p50": p50, "host_stall_ms": 1.0,
+            "skipped_steps": 0, "samples_per_sec": 100.0,
+        })
+
+
+def test_pod_aggregator_percentiles_match_numpy(tmp_path):
+    from tpuddp.observability.aggregate import PodAggregator
+
+    p50s = [1.0, 2.0, 3.0, 10.0]
+    _shard_dir(tmp_path, p50s)
+    agg = PodAggregator(str(tmp_path), 4)
+    merged = agg.update()
+    assert merged["hosts_reporting"] == 4
+    assert merged["pod_step_time_ms_p50"] == pytest.approx(
+        np.median(p50s), rel=1e-6
+    )
+    assert merged["pod_step_time_ms_p95"] == pytest.approx(
+        np.percentile(p50s, 95), rel=1e-6
+    )
+    assert merged["pod_step_time_ms_max"] == 10.0
+    assert merged["pod_host_stall_ms"] == pytest.approx(4.0)
+    assert merged["hosts"]["3"]["step_time_ms_p50"] == 10.0
+    # empty dir -> None, never a crash
+    empty = PodAggregator(str(tmp_path / "none"), 2)
+    assert empty.update() is None
+
+
+def test_straggler_fires_at_exact_ratio_and_window(tmp_path):
+    """The detector's contract: a host over ratio x pod-median for EXACTLY
+    `straggler_windows` consecutive fresh windows produces exactly ONE typed
+    event naming it; uniform hosts never fire; a recovered host can fire
+    again on relapse; a stalled (non-fresh) shard never extends a streak."""
+    from tpuddp.observability.aggregate import PodAggregator
+
+    written = []
+
+    class W:
+        def write(self, r):
+            written.append(r)
+
+    agg = PodAggregator(
+        str(tmp_path), 4, writer=W(),
+        straggler_ratio=1.5, straggler_windows=3,
+    )
+    # uniform pod: many windows, zero events
+    for w in range(1, 5):
+        _shard_dir(tmp_path, [1.0, 1.0, 1.1, 0.9], window=w)
+        agg.update()
+    assert written == [] and agg.straggler_events == 0
+
+    # host 3 goes slow: 2.0 vs median ~1.0 -> ratio 2.0 > 1.5
+    for w in range(5, 8):  # exactly 3 consecutive slow fresh windows
+        _shard_dir(tmp_path, [1.0, 1.0, 1.0, 2.0], window=w)
+        merged = agg.update()
+        if w < 7:
+            assert written == []  # not yet: needs 3 consecutive
+    assert len(written) == 1
+    ev = written[0]
+    assert ev["type"] == "event" and ev["event"] == "straggler"
+    assert ev["host"] == 3 and ev["windows"] == 3
+    assert ev["ratio"] == pytest.approx(2.0)
+    assert merged["stragglers"] == [3]
+    # still slow: the SAME episode never re-fires
+    _shard_dir(tmp_path, [1.0, 1.0, 1.0, 2.0], window=8)
+    agg.update()
+    assert len(written) == 1
+    # a stalled shard (same window index) cannot extend/refire either
+    agg2 = PodAggregator(
+        str(tmp_path / "stall"), 2, writer=W(),
+        straggler_ratio=1.5, straggler_windows=2,
+    )
+    os.makedirs(tmp_path / "stall", exist_ok=True)
+    from tpuddp.observability import aggregate as agg_mod
+
+    for pid, p50 in ((0, 1.0), (1, 5.0)):
+        agg_mod.publish_shard(str(tmp_path / "stall"), pid, {
+            "window_index": 1, "step_time_ms_p50": p50,
+        })
+    before = len(written)
+    for _ in range(5):  # window never advances -> streak frozen at 1
+        agg2.update()
+    assert len(written) == before
+    # recovery then relapse: a SECOND event is legitimate
+    _shard_dir(tmp_path, [1.0, 1.0, 1.0, 1.0], window=9)
+    agg.update()  # recovered
+    for w in range(10, 13):
+        _shard_dir(tmp_path, [1.0, 1.0, 1.0, 3.0], window=w)
+        agg.update()
+    assert len(written) == 2 and written[1]["host"] == 3
+    # knob validation
+    with pytest.raises(ValueError, match="straggler_ratio"):
+        PodAggregator(str(tmp_path), 2, straggler_ratio=1.0)
+    with pytest.raises(ValueError, match="straggler_windows"):
+        PodAggregator(str(tmp_path), 2, straggler_windows=0)
+
+
+# -------------------------------------------------------- flight recorder --
+
+
+def test_flight_ring_bound_and_dump_validates(tmp_path):
+    from tpuddp.observability.flight import FlightRecorder
+
+    rec = FlightRecorder(str(tmp_path), capacity=3, process_index=0)
+    rec.observe(schema_mod.make_run_meta(comm_hook="none"))
+    for i in range(7):
+        rec.observe(stamp("step_stats", {
+            "epoch": 0, "step_start": i * 2, "steps": 2,
+            "step_time_ms_p50": 1.0, "step_time_ms_p95": 1.0,
+            "step_time_ms_p99": 1.0, "step_time_ms_max": 1.0,
+            "samples_per_sec": 10.0, "host_stall_ms": 0.0,
+            "inflight_depth": 0, "staging_queue_depth": 0,
+        }))
+    rec.observe(stamp("event", {"event": "preempt", "epoch": 0, "step": 14}))
+    rec.note(emergency_step=14)
+    path = rec.dump("preempt")
+    assert path and os.path.basename(path) == "flightrec_preempt.json"
+    errors, n = schema_mod.validate_flight_file(path)
+    assert errors == [] and n == 4  # 3-capped step_stats ring + 1 event
+    payload = json.load(open(path))
+    assert payload["counts"]["step_stats"] == 3  # ring bound respected
+    assert payload["records"]["step_stats"][-1]["step_start"] == 12
+    assert payload["notes"]["emergency_step"] == 14
+    assert payload["observed_records"] == 9
+    # idempotent per reason
+    assert rec.dump("preempt") == path
+    # no save_dir -> None, never a crash
+    assert FlightRecorder(None).dump("exception") is None
+
+
+def test_flight_payload_drift_rejected():
+    from tpuddp.observability.flight import FlightRecorder
+
+    rec = FlightRecorder(None, capacity=4)
+    rec.observe(stamp("event", {"event": "x"}))
+    good = rec.payload("exception")
+    assert schema_mod.validate_flight_payload(good) == []
+    # unknown reason
+    errs = schema_mod.validate_flight_payload(dict(good, reason="mystery"))
+    assert any("unknown reason" in e for e in errs)
+    # missing envelope field
+    dropped = {k: v for k, v in good.items() if k != "counts"}
+    assert any("counts" in e for e in schema_mod.validate_flight_payload(dropped))
+    # a ring holding a record of the wrong type
+    bad = json.loads(json.dumps(good))
+    bad["records"]["step_stats"] = [stamp("event", {"event": "y"})]
+    errs = schema_mod.validate_flight_payload(bad)
+    assert any("does not belong" in e for e in errs)
+    # newer-version reject
+    errs = schema_mod.validate_flight_payload(
+        dict(good, schema_version=schema_mod.SCHEMA_VERSION + 1)
+    )
+    assert any("newer" in e for e in errs)
+    # wrong type marker
+    errs = schema_mod.validate_flight_payload(dict(good, type="history"))
+    assert any("flight_recording" in e for e in errs)
+
+
+def test_flight_dump_on_loop_exception(mesh, tmp_path):
+    """An unhandled exception in the native epoch driver leaves a validated
+    flightrec_exception.json holding the run header and the records written
+    before the crash."""
+    class PoisonedLoader:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+        def __len__(self):
+            return len(self.inner)
+
+        def __iter__(self):
+            it = iter(self.inner)
+            yield next(it)
+            raise RuntimeError("injected loader failure")
+
+    ds = SyntheticClassification(n=256, shape=(8, 8, 3), seed=0)
+    loader = ShardedDataLoader(ds, 8, mesh, shuffle=True)
+    test_loader = ShardedDataLoader(ds, 8, mesh, shuffle=True)
+    ddp = DistributedDataParallel(
+        ToyMLP(hidden=(16,)), optim.Adam(1e-2), CrossEntropyLoss(), mesh=mesh
+    )
+    state = ddp.init_state(jax.random.key(0), jnp.zeros((1, 8, 8, 3)))
+    with pytest.raises(RuntimeError, match="injected loader failure"):
+        run_training_loop(
+            ddp, state, PoisonedLoader(loader), test_loader, str(tmp_path),
+            num_epochs=2, checkpoint_epoch=1, step_stats_every=2,
+            log=lambda *_: None,
+        )
+    path = tmp_path / "flightrec_exception.json"
+    assert path.exists()
+    errors, _ = schema_mod.validate_flight_file(str(path))
+    assert errors == []
+    payload = json.load(open(path))
+    assert payload["reason"] == "exception"
+    assert payload["run_meta"]["api"] == "native"
+    # the recorder registry is clean after the loop's finally
+    from tpuddp.observability import flight as flight_mod
+
+    assert flight_mod._registry == []
+
+
+@pytest.mark.slow
+def test_flight_dump_on_exit75_matches_emergency_checkpoint(tmp_path):
+    """ISSUE 10 acceptance (chaos leg): an injected preempt drains to exit
+    75 and leaves a tpuddp_inspect-valid flight recording whose emergency
+    note and preempt event agree with the emergency checkpoint's step."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "TPUDDP_BACKEND": "cpu",
+        "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+        "TPUDDP_FAULT": "preempt@epoch=1",
+        "TPUDDP_CHAOS_TRAINING": '{"step_stats_every": 2}',
+    })
+    proc = subprocess.run(
+        [sys.executable, "-u",
+         os.path.join(repo, "tests", "_chaos_train_worker.py"),
+         str(tmp_path), "3"],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 75, proc.stdout + proc.stderr
+    path = tmp_path / "flightrec_preempt.json"
+    assert path.exists()
+    # the CLI validates it (the gate's path)
+    check = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "tpuddp_inspect.py"),
+         "--validate", str(path)],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert check.returncode == 0, check.stdout + check.stderr
+    payload = json.load(open(path))
+    assert payload["reason"] == "preempt"
+    preempts = [
+        e for e in payload["records"]["event"] if e["event"] == "preempt"
+    ]
+    assert len(preempts) == 1
+    # the recording's last window ends at (or before) the emergency step,
+    # and the notes name the checkpoint the drain wrote
+    notes = payload["notes"]
+    assert notes["emergency_step"] == preempts[0]["step"]
+    assert os.path.exists(notes["emergency_checkpoint"])
+    windows = payload["records"]["step_stats"]
+    assert windows, "no step_stats windows retained"
+    last = windows[-1]
+    assert last["step_start"] + last["steps"] <= notes["emergency_step"]
+    # the emergency checkpoint is the newest on disk and restores at the
+    # epoch the preempt event names
+    from tpuddp.training import checkpoint as _ckpt
+
+    newest = _ckpt.latest(str(tmp_path))
+    assert newest is not None
+    assert os.path.basename(newest[0]) == os.path.basename(
+        notes["emergency_checkpoint"]
+    )
+
+
+# --------------------------------------------------------- schema v5 drift --
+
+
+def test_schema_v5_requires_observability_field(tmp_path):
+    """Live-plane schema bump: a run_meta stamped v5+ without the
+    ``observability`` key is drift; v4 headers keep validating at their own
+    version; the shared make_run_meta always carries the key (null = plane
+    off)."""
+    meta = schema_mod.make_run_meta(
+        comm_hook="none", observability={"exporter": False}
+    )
+    assert meta["schema_version"] >= 5
+    assert schema_mod.validate_history_records([meta]) == []
+    # null is legal (a minimal watchdog header)...
+    assert schema_mod.validate_history_records(
+        [schema_mod.make_run_meta(comm_hook=None)]
+    ) == []
+    # ...but ABSENCE at v5 is drift
+    dropped = {k: v for k, v in meta.items() if k != "observability"}
+    errs = schema_mod.validate_history_records([dropped])
+    assert any("observability" in e for e in errs), errs
+    # a v4 header without the field stays valid (its version's contract)
+    v4 = dict(dropped, schema_version=4)
+    assert schema_mod.validate_history_records([v4]) == []
+    # the drift also fails through the file validator (the gate's path)
+    p = tmp_path / "drift5.jsonl"
+    p.write_text(json.dumps(dropped) + "\n")
+    errors, _ = schema_mod.validate_history_file(str(p))
+    assert any("observability" in e for e in errors)
+
+
+# ------------------------------------- inspect: resumed-run attribution fix --
+
+
+def test_inspect_attributes_rows_to_latest_header(tmp_path):
+    """ISSUE 10 satellite: after an elastic shrink-resume the summary's
+    per-epoch table marks which header owns each row and the grad-comm
+    savings line uses ONLY the latest run segment — pre- and post-resume
+    worlds never mix."""
+    import subprocess
+    import sys
+
+    # a realistic shrink-resume stream: world 4 (16 B/update) then a resumed
+    # world 2 (8 B/update, resumed_from_world=4), built from the real
+    # make_run_meta/stamp writers so it validates at v5
+    records = [
+        schema_mod.make_run_meta(
+            world_size=4, comm_hook="bf16_ef", comm_topology="flat",
+            extra={
+                "api": "native",
+                "grad_comm_bytes_per_update": 16,
+                "grad_comm_bytes_per_update_f32": 32,
+            },
+        ),
+    ]
+
+    def epoch_row(epoch, total):
+        return stamp("epoch", {
+            "epoch": epoch, "train_loss": 1.0, "test_loss": 1.0,
+            "test_accuracy": 50.0, "train_samples": 256, "test_samples": 64,
+            "epoch_time_s": 1.0, "samples_per_sec": 320.0,
+            "step_time_ms_p50": 1.0, "step_time_ms_p95": 1.0,
+            "step_time_ms_p99": 1.0, "step_time_ms_max": 1.0,
+            "mfu_p50": None, "grad_comm_bytes_total": total,
+        })
+
+    records += [epoch_row(0, 160), epoch_row(1, 320)]
+    records.append(schema_mod.make_run_meta(
+        world_size=2, comm_hook="bf16_ef", comm_topology="flat",
+        extra={
+            "api": "native",
+            "resumed_from_world": 4,
+            "grad_comm_bytes_per_update": 8,
+            "grad_comm_bytes_per_update_f32": 16,
+        },
+    ))
+    records.append(stamp("event", {
+        "event": "topology_change", "from_world": 4, "to_world": 2,
+    }))
+    records += [epoch_row(2, 80)]
+    path = tmp_path / "history.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+    assert schema_mod.validate_history_records(records) == []
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "tpuddp_inspect.py")
+    out = subprocess.run(
+        [sys.executable, tool, str(path)],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    # the table names the owning run per row
+    assert "epochs (3 across 2 runs" in out.stdout
+    lines = out.stdout.splitlines()
+    run_col = [
+        line.split() for line in lines
+        if line.strip() and line.split()[0] in ("0", "1", "2")
+        and len(line.split()) > 5
+    ]
+    by_epoch = {cells[1]: cells[0] for cells in run_col}
+    assert by_epoch["0"] == "0" and by_epoch["1"] == "0"
+    assert by_epoch["2"] == "1"  # the resumed epoch belongs to header 1
+    # grad-comm savings come from the LATEST segment: 8 B/update vs 16 B
+    # f32 and the resumed run's own 80 B total — not the old world's 320
+    assert "8 B/update on the wire vs 16 B" in out.stdout
+    assert "80 B total this run (latest of 2)" in out.stdout
+    assert "320 B total" not in out.stdout
+    # resumed provenance is surfaced in the header block
+    assert "resumed_from_world: 4" in out.stdout
+
+
+def test_inspect_real_resumed_history_gains_run_column(mesh, tmp_path):
+    """The same attribution over a REAL resumed run (double-header history
+    from the actual writers)."""
+    import subprocess
+    import sys
+
+    ddp, (state, _) = small_run(mesh, str(tmp_path), num_epochs=1)
+    restored, start = ckpt.restore_latest(
+        str(tmp_path), ddp.init_state(jax.random.key(0), jnp.zeros((1, 8, 8, 3)))
+    )
+    small_run(
+        mesh, str(tmp_path), num_epochs=2, start_epoch=start, state=restored
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "tpuddp_inspect.py"),
+         str(tmp_path / "history.jsonl")],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "across 2 runs" in out.stdout
+
+
+def test_inspect_validates_and_summarizes_flight_recording(tmp_path):
+    """The CLI's flight kind: --validate accepts a real dump, the summary
+    renders, and drift (bad reason) is refused."""
+    import subprocess
+    import sys
+
+    from tpuddp.observability.flight import FlightRecorder
+
+    rec = FlightRecorder(str(tmp_path), capacity=4)
+    rec.observe(schema_mod.make_run_meta(comm_hook="none", extra={"api": "native"}))
+    rec.observe(stamp("event", {"event": "preempt", "epoch": 1, "step": 8}))
+    path = rec.dump("preempt")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "tpuddp_inspect.py")
+    ok = subprocess.run(
+        [sys.executable, tool, "--validate", path],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "flight record" in ok.stdout
+    summary = subprocess.run(
+        [sys.executable, tool, path], capture_output=True, text=True, cwd=repo,
+    )
+    assert summary.returncode == 0
+    assert "reason=preempt" in summary.stdout
+    assert "preempt" in summary.stdout
+    bad = tmp_path / "flightrec_bogus.json"
+    payload = json.load(open(path))
+    payload["reason"] = "mystery"
+    bad.write_text(json.dumps(payload))
+    refused = subprocess.run(
+        [sys.executable, tool, "--validate", str(bad)],
+        capture_output=True, text=True, cwd=repo,
+    )
+    assert refused.returncode == 1
+    assert "unknown reason" in refused.stderr
+
+
+def test_supervisor_summarizes_flight_before_restart(tmp_path, caplog):
+    """tools/supervise.py pickup: the supervisor logs the child's flight
+    recording after an abnormal exit, BEFORE deciding the restart."""
+    import logging as _logging
+
+    from tpuddp.observability.flight import FlightRecorder
+    from tpuddp.resilience.supervisor import RestartSupervisor, SupervisorPolicy
+
+    calls = {"n": 0}
+
+    def runner(argv, env):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            rec = FlightRecorder(str(tmp_path), capacity=4)
+            rec.observe(schema_mod.make_run_meta(
+                comm_hook="none", extra={"api": "native"}
+            ))
+            rec.observe(stamp("event", {"event": "preempt", "epoch": 0}))
+            rec.dump("preempt")
+            return 75
+        return 0
+
+    sup = RestartSupervisor(
+        ["cmd"], policy=SupervisorPolicy(max_restarts=3),
+        runner=runner, sleep=lambda s: None, flight_dir=str(tmp_path),
+    )
+    with caplog.at_level(_logging.WARNING, logger="tpuddp"):
+        rc = sup.run()
+    assert rc == 0 and calls["n"] == 2
+    flight_lines = [
+        r.message for r in caplog.records if "flight recording" in r.message
+    ]
+    assert flight_lines, "supervisor never summarized the recording"
+    assert any("reason=preempt" in m for m in flight_lines)
+    # the same recording is not re-summarized on later exits
+    assert len([m for m in flight_lines if "reason=preempt" in m]) == 1
+
+
+def test_exporter_escapes_label_values():
+    """A caller-supplied label value (tenant id!) containing quotes,
+    backslashes, or newlines must not corrupt the exposition page."""
+    from tpuddp.observability.exporter import MetricsExporter
+
+    e = MetricsExporter(port=0)
+    e.register_source("t", lambda: {
+        "serving_tenant_completed_total": {
+            "type": "counter", "help": "h",
+            "values": [({"tenant": 'acme"prod\\x\ny'}, 3)],
+        },
+    })
+    text = e.render_prometheus()
+    line = [l for l in text.splitlines() if l.startswith(
+        "tpuddp_serving_tenant_completed_total{")][0]
+    assert line == (
+        'tpuddp_serving_tenant_completed_total'
+        '{tenant="acme\\"prod\\\\x\\ny"} 3'
+    )
+    assert "\n\n" not in text  # no raw newline leaked mid-sample
+
+
+def test_flight_dump_per_process_qualified(tmp_path):
+    """On a shared save_dir, non-zero processes dump under their own name —
+    a pod-wide death must not be last-rename-wins."""
+    from tpuddp.observability.flight import FlightRecorder, find_recordings
+
+    for pid in (0, 1, 2):
+        rec = FlightRecorder(str(tmp_path), capacity=2, process_index=pid)
+        rec.observe(stamp("event", {"event": "watchdog_stale", "process": pid}))
+        rec.dump("watchdog")
+    names = sorted(os.path.basename(p) for p in find_recordings(str(tmp_path)))
+    assert names == [
+        "flightrec_watchdog.json",
+        "flightrec_watchdog_p1.json",
+        "flightrec_watchdog_p2.json",
+    ]
+    for path in find_recordings(str(tmp_path)):
+        errors, _ = schema_mod.validate_flight_file(path)
+        assert errors == []
+
+
+def test_exporter_port_file_per_process_name(tmp_path, monkeypatch):
+    """exporter_from_config qualifies the discovery file by process index —
+    the shared run dir must hold one file per serving host."""
+    import jax as _jax
+
+    from tpuddp.observability import exporter as exp_mod
+
+    monkeypatch.setattr(_jax, "process_index", lambda: 2)
+    e = exp_mod.exporter_from_config(
+        {"exporter": True, "exporter_port": 0}, run_dir=str(tmp_path)
+    )
+    assert e.port_filename == "exporter_p2.port"
+    e.start()
+    try:
+        assert int((tmp_path / "exporter_p2.port").read_text()) == e.port
+        assert not (tmp_path / "exporter.port").exists()
+    finally:
+        e.stop()
+    assert not (tmp_path / "exporter_p2.port").exists()

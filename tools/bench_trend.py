@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""bench_trend — the per-row benchmark trajectory + regression gate.
+
+Reads every committed ``BENCH_r*.json`` at the repo root (plus, with
+``--fresh``, an uncommitted run's ``bench_results.json``), normalizes the
+two artifact shapes the repo has accumulated — the raw driver capture
+(``{cmd, parsed, tail, ...}``, r01–r05) and the direct bench payload
+(``{metric, configs, ...}``, r06+) — and prints each config row's
+samples/sec + MFU trajectory across releases.
+
+Regression rule: the CANDIDATE (the ``--fresh`` artifact when given, else
+the newest committed one) is compared row by row against the BEST earlier
+value of the same row name **on the same device** (a CPU-rung run must
+never be judged against a TPU row of the same name). Any candidate row
+whose ``samples_per_sec_per_chip`` falls more than ``--threshold`` (default
+10%) below its historical best exits nonzero — wired into
+``tools/run_full_gate.py`` so a perf regression fails the gate like a
+schema drift does.
+
+Usage:
+    python tools/bench_trend.py                       # committed trajectory
+    python tools/bench_trend.py --fresh bench_results.json
+    python tools/bench_trend.py --threshold 0.05
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def normalize(path):
+    """Extract ``(tag, device, configs)`` from either artifact shape, or
+    None when the file holds no per-config rows (e.g. r01's summary-only
+    capture — reported, not fatal)."""
+    tag = os.path.splitext(os.path.basename(path))[0]
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_trend: skipping unreadable {path}: {e}",
+              file=sys.stderr)
+        return None
+    if not isinstance(obj, dict):
+        return None
+    payload = None
+    if isinstance(obj.get("configs"), dict):
+        payload = obj
+    elif isinstance(obj.get("parsed"), dict) and isinstance(
+        obj["parsed"].get("configs"), dict
+    ):
+        payload = obj["parsed"]
+    else:
+        # driver capture whose parse failed: the payload is the LAST
+        # stdout line of the tail (bench.py's parseable-summary contract)
+        tail = obj.get("tail")
+        if isinstance(tail, list):
+            tail = "\n".join(tail)
+        if isinstance(tail, str):
+            for line in reversed(tail.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        parsed = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(parsed, dict) and isinstance(
+                        parsed.get("configs"), dict
+                    ):
+                        payload = parsed
+                    break
+    if payload is None:
+        return None
+    configs = {
+        name: row
+        for name, row in payload["configs"].items()
+        if isinstance(row, dict)
+    }
+    if not configs:
+        return None
+    return tag, payload.get("device") or "unknown", configs
+
+
+def load_artifacts(fresh=None, repo=_REPO):
+    """Committed BENCH_r*.json (release order) + the optional fresh run."""
+    artifacts = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        norm = normalize(path)
+        if norm is None:
+            print(f"bench_trend: {os.path.basename(path)} carries no config "
+                  "rows (skipped)")
+            continue
+        artifacts.append(norm)
+    if fresh:
+        norm = normalize(fresh)
+        if norm is None:
+            print(f"bench_trend: --fresh {fresh} carries no config rows",
+                  file=sys.stderr)
+            return artifacts, None
+        norm = (f"fresh:{norm[0]}", norm[1], norm[2])
+        artifacts.append(norm)
+    return artifacts, artifacts[-1] if artifacts else None
+
+
+def _num(row, key):
+    v = row.get(key)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def print_trajectory(artifacts) -> None:
+    """Per-row samples/sec (and MFU where known) across releases."""
+    rows = []
+    seen = []
+    for _tag, device, configs in artifacts:
+        for name in configs:
+            if (device, name) not in seen:
+                seen.append((device, name))
+    header = ["row", "device"] + [tag for tag, _, _ in artifacts]
+    for device, name in seen:
+        cells = [name[:44], device]
+        for _tag, dev, configs in artifacts:
+            row = configs.get(name) if dev == device else None
+            if row is None:
+                cells.append("-")
+                continue
+            sps = _num(row, "samples_per_sec_per_chip")
+            mfu = _num(row, "mfu")
+            cell = f"{sps:,.0f}" if sps is not None else "?"
+            if mfu is not None:
+                cell += f"/{mfu:.3f}"
+            cells.append(cell)
+        rows.append(cells)
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows else len(header[i])
+        for i in range(len(header))
+    ]
+    print("  ".join(h.ljust(w) for h, w in zip(header, widths)))
+    print("-" * (sum(widths) + 2 * (len(widths) - 1)))
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    print("(cells: samples/sec/chip, '/MFU' where recorded)")
+
+
+def check_regressions(artifacts, candidate, threshold: float):
+    """Candidate rows vs their same-device historical best. Returns the
+    list of regression description strings (empty = pass)."""
+    cand_tag, cand_device, cand_configs = candidate
+    history = [a for a in artifacts if a[0] != cand_tag]
+    regressions = []
+    for name, row in cand_configs.items():
+        sps = _num(row, "samples_per_sec_per_chip")
+        if sps is None:
+            continue
+        best = None
+        best_tag = None
+        for tag, device, configs in history:
+            if device != cand_device:
+                continue
+            prev = configs.get(name)
+            if prev is None:
+                continue
+            prev_sps = _num(prev, "samples_per_sec_per_chip")
+            if prev_sps is not None and (best is None or prev_sps > best):
+                best, best_tag = prev_sps, tag
+        if best is None or best <= 0:
+            continue
+        drop = 1.0 - sps / best
+        if drop > threshold:
+            regressions.append(
+                f"{name!r} on {cand_device}: {sps:,.1f} samples/s/chip in "
+                f"{cand_tag} is {drop * 100:.1f}% below the best "
+                f"{best:,.1f} ({best_tag}) — over the "
+                f"{threshold * 100:.0f}% floor"
+            )
+    return regressions
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Per-row bench trajectory across committed BENCH_r*.json "
+        "artifacts, failing on a >threshold regression of any best row.",
+    )
+    parser.add_argument("--fresh", default=None, metavar="PATH",
+                        help="an uncommitted bench_results.json to judge as "
+                        "the candidate (default: the newest committed "
+                        "artifact)")
+    parser.add_argument("--threshold", type=float, default=0.10,
+                        help="allowed fractional drop vs the historical best "
+                        "(default 0.10)")
+    parser.add_argument("--repo", default=_REPO, help=argparse.SUPPRESS)
+    args = parser.parse_args(argv)
+
+    artifacts, candidate = load_artifacts(args.fresh, repo=args.repo)
+    if not artifacts:
+        print("bench_trend: no BENCH_r*.json artifacts with config rows "
+              "found", file=sys.stderr)
+        return 2
+    print_trajectory(artifacts)
+    regressions = check_regressions(artifacts, candidate, args.threshold)
+    if regressions:
+        for r in regressions:
+            print(f"REGRESSION: {r}", file=sys.stderr)
+        return 1
+    print(f"bench_trend: no row of candidate {candidate[0]} regressed more "
+          f"than {args.threshold * 100:.0f}% against its same-device best")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,6 +171,11 @@ def main(argv=None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--quick", action="store_true",
                         help="CI sizing: ~100 requests total, tiny model")
+    parser.add_argument("--exporter", nargs="?", const=0, default=None,
+                        type=int, metavar="PORT",
+                        help="serve the live /metrics endpoint during the "
+                        "run (PORT omitted or 0 = ephemeral; the bound port "
+                        "lands in <history-dir>/exporter.port)")
     args = parser.parse_args(argv)
 
     from tpuddp import config as config_lib
@@ -193,13 +198,20 @@ def main(argv=None) -> int:
         cfg["max_batch_size"] = min(int(cfg["max_batch_size"]), 8)
         cfg["stats_window"] = 16
 
-    engine = ServingEngine.from_config(cfg, out_dir=args.history_dir)
+    observability = None
+    if args.exporter is not None:
+        observability = {"exporter": True, "exporter_port": args.exporter}
+    engine = ServingEngine.from_config(
+        cfg, out_dir=args.history_dir, observability=observability
+    )
     log(
         f"engine: model={cfg['model']} replicas={len(engine.pool)} "
         f"max_batch={engine.scheduler.max_batch_size} "
         f"buckets={engine.scheduler.buckets} tenants={args.tenants}"
     )
     engine.start()  # warms every bucket program on every replica
+    if engine.exporter is not None:
+        log(f"exporter: /metrics on {engine.exporter.host}:{engine.exporter.port}")
 
     rng = np.random.RandomState(args.seed)
     shape = engine.pool.sample_shape

@@ -55,6 +55,17 @@ The merged history.jsonl must validate and carry a topology_change event
 row; elastic restore drifting (a reshard that crashes, or stops recording
 its provenance) fails the gate here.
 
+Observability gate (last): tools/bench_trend.py across the committed
+BENCH_r*.json artifacts (a >10% regression of any same-device best row
+fails), a live exporter scrape (a serving engine with the
+observability.exporter block must answer /healthz + the serving /metrics
+families while running, then SIGTERM-drain to exit 75 with a schema-v5
+history), and a flight-recorder leg (a chaos-preempted training run must
+leave a tpuddp_inspect-valid flightrec_preempt.json which the restart
+supervisor summarizes — --flight-dir — before resuming the run to
+completion). A dead endpoint, schema-v5 drift, a missing crash recording,
+or a bench regression all fail here.
+
 Usage: python tools/run_full_gate.py [extra pytest args]
 
 The two-tier contract is documented in README "Testing"; the chaos tier can
@@ -420,6 +431,185 @@ def _pipeline_gate(env) -> int:
     return 0
 
 
+def _observability_gate(env) -> int:
+    """Live-telemetry leg (ISSUE 10): (a) tools/bench_trend.py across the
+    committed BENCH_r*.json artifacts — a >10% regression of any best
+    same-device row fails the gate; (b) exporter scrape — a serving engine
+    stood up with the observability.exporter block must answer /healthz and
+    serve the expected /metrics families while live, then drain to exit 75
+    with a schema-v5-valid history; (c) flight recorder — a chaos-preempted
+    training run (exit 75) must leave a flightrec_preempt.json that
+    tpuddp_inspect validates, and the restart supervisor must summarize it
+    (--flight-dir) before resuming the run to completion."""
+    import json
+    import signal
+    import time
+    import urllib.request
+
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    rc = subprocess.call(
+        [sys.executable, os.path.join(REPO, "tools", "bench_trend.py")],
+        cwd=REPO, env=env,
+    )
+    if rc != 0:
+        print("observability gate: bench_trend regression", file=sys.stderr)
+        return rc
+
+    # -- exporter scrape leg ------------------------------------------------
+    with tempfile.TemporaryDirectory(prefix="tpuddp_obs_gate_") as tmp:
+        base_env = dict(env)
+        base_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        out_dir = os.path.join(tmp, "serve")
+        os.makedirs(out_dir)
+        settings = os.path.join(tmp, "settings.yaml")
+        with open(settings, "w") as f:
+            f.write(
+                "out_dir: %s\n"
+                "serving:\n"
+                "  num_replicas: 2\n"
+                "  max_batch_size: 8\n"
+                "  stats_window: 16\n"
+                "observability:\n"
+                "  exporter: true\n"
+                "  exporter_port: 0\n" % out_dir
+            )
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-u", "-m", "tpuddp.serving",
+                "--settings", settings, "--demo", "48", "--serve", "120",
+            ],
+            cwd=REPO, env=base_env,
+        )
+        try:
+            port_file = os.path.join(out_dir, "exporter.port")
+            deadline = time.time() + 120
+            port = None
+            while time.time() < deadline:
+                if os.path.exists(port_file):
+                    port = int(open(port_file).read().strip())
+                    break
+                if proc.poll() is not None:
+                    print("observability gate: serving process died before "
+                          f"binding the exporter (rc {proc.returncode})",
+                          file=sys.stderr)
+                    return proc.returncode or 1
+                time.sleep(0.2)
+            if port is None:
+                print("observability gate: exporter.port never appeared",
+                      file=sys.stderr)
+                return 1
+            # the engine may still be mid-demo: poll until the serving
+            # series report traffic (a dead endpoint fails the gate here)
+            scraped = None
+            while time.time() < deadline:
+                health = json.load(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10
+                ))
+                if health.get("status") != "ok":
+                    print(f"observability gate: /healthz said {health}",
+                          file=sys.stderr)
+                    return 1
+                scraped = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10
+                ).read().decode()
+                done = [
+                    line for line in scraped.splitlines()
+                    if line.startswith("tpuddp_serving_completed_total ")
+                ]
+                if done and float(done[0].split()[-1]) >= 48:
+                    break
+                time.sleep(0.2)
+            for family in (
+                "tpuddp_serving_completed_total",
+                "tpuddp_serving_e2e_ms",
+                "tpuddp_serving_throughput_rps",
+                "tpuddp_serving_replicas_healthy",
+            ):
+                if family not in (scraped or ""):
+                    print(f"observability gate: /metrics is missing "
+                          f"{family}", file=sys.stderr)
+                    return 1
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        if rc != 75:
+            print(f"observability gate: drained server exited {rc}, "
+                  "expected 75", file=sys.stderr)
+            return rc or 1
+        rc = subprocess.call(
+            [sys.executable, inspect, "--validate",
+             os.path.join(out_dir, "history.jsonl")],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("observability gate: drained server history failed "
+                  "validation", file=sys.stderr)
+            return rc
+
+        # -- flight recorder leg -------------------------------------------
+        train_dir = os.path.join(tmp, "train")
+        os.makedirs(train_dir)
+        env1 = dict(base_env)
+        env1.update({
+            "TPUDDP_FAULT": "preempt@epoch=1",
+            "TPUDDP_CHAOS_TRAINING": '{"step_stats_every": 2}',
+        })
+        worker = os.path.join(REPO, "tests", "_chaos_train_worker.py")
+        rc = subprocess.call(
+            [sys.executable, "-u", worker, train_dir, "3"],
+            cwd=REPO, env=env1,
+        )
+        if rc != 75:
+            print(f"observability gate: preempted run exited {rc}, "
+                  "expected 75", file=sys.stderr)
+            return rc or 1
+        flightrec = os.path.join(train_dir, "flightrec_preempt.json")
+        if not os.path.exists(flightrec):
+            print("observability gate: no flightrec_preempt.json after the "
+                  "exit-75 drain", file=sys.stderr)
+            return 1
+        rc = subprocess.call(
+            [sys.executable, inspect, "--validate", flightrec],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("observability gate: flight recording failed validation",
+                  file=sys.stderr)
+            return rc
+        # the supervisor picks the recording up (--flight-dir) and resumes
+        # the run to completion
+        resume = subprocess.run(
+            [
+                sys.executable, "-u",
+                os.path.join(REPO, "tools", "supervise.py"),
+                "--max-restarts", "2", "--auto-resume",
+                "--backoff-base", "0.2", "--flight-dir", train_dir,
+                "--",
+                sys.executable, "-u", worker, train_dir, "3",
+            ],
+            cwd=REPO, env=base_env, capture_output=True, text=True,
+        )
+        if resume.returncode != 0:
+            print("observability gate: supervised resume exited "
+                  f"{resume.returncode}\n{resume.stdout}\n{resume.stderr}",
+                  file=sys.stderr)
+            return resume.returncode
+        if "flight recording" not in resume.stderr + resume.stdout:
+            print("observability gate: supervisor never summarized the "
+                  "flight recording", file=sys.stderr)
+            return 1
+    print("observability gate: bench trend + live scrape + flight "
+          "recording verified")
+    return 0
+
+
 def main(argv=None):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")  # the full gate never needs a real TPU
@@ -444,7 +634,10 @@ def main(argv=None):
     rc = _serving_gate(env)
     if rc != 0:
         return rc
-    return _elastic_gate(env)
+    rc = _elastic_gate(env)
+    if rc != 0:
+        return rc
+    return _observability_gate(env)
 
 
 if __name__ == "__main__":

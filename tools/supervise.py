@@ -70,6 +70,11 @@ def parse_args(argv=None):
     parser.add_argument("--auto-resume", action="store_true",
                         help="set $TPUDDP_AUTO_RESUME=1 on the FIRST attempt "
                         "too (restarts always resume)")
+    parser.add_argument("--flight-dir", default=None, metavar="DIR",
+                        help="summarize flightrec_<reason>.json crash "
+                        "recordings from DIR (usually the run's out_dir) at "
+                        "startup and after every abnormal child exit, before "
+                        "deciding restart/shrink")
     parser.add_argument("--first-env", action="append", default=[],
                         metavar="KEY=VAL",
                         help="env applied to attempt 0 only (repeatable; "
@@ -113,6 +118,7 @@ def main(argv=None) -> int:
         world_size=args.world,
         first_attempt_env=first_env,
         auto_resume_first=args.auto_resume,
+        flight_dir=args.flight_dir,
     ).run()
 
 

@@ -8,7 +8,16 @@ Works on both machine-readable artifacts the framework writes:
   tpuddp/observability/schema.py) — prints the run header, a per-epoch
   table with step-time percentiles, the event timeline, and the
   gradient-comm byte savings a compressed hook achieved;
-- ``bench_results.json`` (the bench harness's full per-config payload).
+- ``bench_results.json`` (the bench harness's full per-config payload);
+- ``flightrec_<reason>.json`` (the crash flight recorder's post-mortem
+  sidecar, tpuddp/observability/flight.py) — validates the ring contents
+  against the same per-record schema and pretty-prints the last windows,
+  epochs, and event timeline the crashed run saw.
+
+An elastically-resumed history (several ``run_meta`` headers back to back)
+attributes every epoch row to the header that OWNS it: the per-epoch table
+gains a ``run`` column and the grad-comm savings line uses only the latest
+run segment, so pre- and post-resume worlds never mix in one figure.
 
 Usage:
     python tools/tpuddp_inspect.py <path> [--validate] [--events]
@@ -47,13 +56,17 @@ def _load_schema():
 
 def _detect_kind(path: str) -> str:
     """'bench' (ONE JSON object with metric+configs — possibly
-    pretty-printed across lines) or 'history' (a JSONL record stream, which
-    fails whole-file json.load with 'Extra data' beyond one record)."""
+    pretty-printed across lines), 'flight' (one object stamped
+    type=flight_recording — the crash post-mortem sidecar), or 'history'
+    (a JSONL record stream, which fails whole-file json.load with 'Extra
+    data' beyond one record)."""
     try:
         with open(path) as f:
             obj = json.load(f)
     except ValueError:
         return "history"
+    if isinstance(obj, dict) and obj.get("type") == "flight_recording":
+        return "flight"
     if isinstance(obj, dict) and "configs" in obj and "metric" in obj:
         return "bench"
     return "history"
@@ -94,10 +107,26 @@ def _print_table(rows, headers):
 def summarize_history(path: str) -> None:
     records = _read_history(path)
     metas = [r for r in records if r.get("type") == "run_meta"]
-    epochs = [r for r in records if r.get("type") == "epoch"]
+    # attribute every row to the run_meta header that OWNS it (the newest
+    # header ABOVE it in the stream): an elastically-resumed history holds
+    # several runs back to back, and a summary mixing their worlds — or
+    # computing byte savings from the newest header over the oldest run's
+    # epochs — reads as one run that never happened.
+    run_idx = -1
+    epochs, epoch_runs = [], []
+    for r in records:
+        if r.get("type") == "run_meta":
+            run_idx += 1
+        elif r.get("type") == "epoch":
+            epochs.append(r)
+            epoch_runs.append(max(run_idx, 0))
     # legacy (pre-schema) histories: epoch rows are the ones with losses
     if not epochs:
         epochs = [r for r in records if "train_loss" in r]
+        epoch_runs = [0] * len(epochs)
+    latest_epochs = [
+        e for e, ri in zip(epochs, epoch_runs) if ri == max(run_idx, 0)
+    ]
     events = [r for r in records if r.get("type") == "event" or (
         "type" not in r and "event" in r)]
     steps = [r for r in records if r.get("type") == "step_stats"]
@@ -115,6 +144,8 @@ def summarize_history(path: str) -> None:
             "num_replicas", "max_batch_size", "max_queue_depth",
             "per_tenant_quota", "batch_timeout_ms", "buckets", "input_shape",
             "restored_epoch", "checkpoint_dir",
+            # elastic + live-plane provenance (schema v5)
+            "resumed_from_world", "observability",
         ):
             if m.get(k) is not None:
                 print(f"  {k:>20}: {m[k]}")
@@ -125,10 +156,16 @@ def summarize_history(path: str) -> None:
         print("run_meta: MISSING (pre-schema history?)")
 
     if epochs:
-        print(f"\nepochs ({len(epochs)}):")
+        multi_run = len(metas) > 1
+        if multi_run:
+            print(f"\nepochs ({len(epochs)} across {len(metas)} runs; "
+                  f"'run' column = owning header, newest is "
+                  f"{len(metas) - 1}):")
+        else:
+            print(f"\nepochs ({len(epochs)}):")
         rows = []
-        for e in epochs:
-            rows.append([
+        for e, ri in zip(epochs, epoch_runs):
+            row = [
                 str(e.get("epoch")),
                 _fmt(e.get("train_loss")),
                 _fmt(e.get("test_loss")),
@@ -140,11 +177,17 @@ def summarize_history(path: str) -> None:
                 _fmt(e.get("step_time_ms_p99"), 2),
                 _fmt(e.get("mfu_p50")),
                 str(e.get("skipped_steps_epoch", 0) or 0),
-            ])
-        _print_table(rows, [
+            ]
+            if multi_run:
+                row.insert(0, str(ri))
+            rows.append(row)
+        headers = [
             "ep", "train", "test", "acc%", "t(s)", "sps",
             "p50ms", "p95ms", "p99ms", "mfu50", "skip",
-        ])
+        ]
+        if multi_run:
+            headers.insert(0, "run")
+        _print_table(rows, headers)
         if steps:
             line = (f"\nstep_stats windows: {len(steps)} "
                     f"(finest p99 {max(s.get('step_time_ms_p99') or 0 for s in steps):.2f} ms, "
@@ -194,12 +237,15 @@ def summarize_history(path: str) -> None:
               f"worst-window e2e p99 {worst:.2f} ms")
 
     # gradient-comm byte savings: compressed vs the f32 baseline the header
-    # records; totals from the newest epoch's cumulative counter
-    if metas and epochs:
+    # records. ONLY the latest run segment's epochs belong to the latest
+    # header — after an elastic resume the older epochs trained on a
+    # different world (different per-update bytes), and their cumulative
+    # counter reset at the resume anyway.
+    if metas and latest_epochs:
         m = metas[-1]
         per, base = m.get("grad_comm_bytes_per_update"), m.get(
             "grad_comm_bytes_per_update_f32")
-        total = epochs[-1].get("grad_comm_bytes_total")
+        total = latest_epochs[-1].get("grad_comm_bytes_total")
         if per is not None and base:
             saved = 1.0 - per / base
             line = (f"\ngrad comm: {per:,} B/update on the wire vs {base:,} B "
@@ -207,7 +253,10 @@ def summarize_history(path: str) -> None:
                     f", hook {m.get('comm_hook')}"
                     f", topology {m.get('comm_topology') or 'flat'})")
             if total is not None:
-                line += f"; {total:,} B total this run"
+                line += (
+                    f"; {total:,} B total this run"
+                    + (f" (latest of {len(metas)})" if len(metas) > 1 else "")
+                )
             print(line)
             # hierarchical hop split (schema v4): the compressed inter-host
             # share vs the f32 intra-host (ICI) traffic per update
@@ -227,6 +276,51 @@ def summarize_history(path: str) -> None:
             print(f"  [{ev.get('epoch', '-')}] {ev.get('event')}: {fields}")
     else:
         print("\nevents: none")
+
+
+def summarize_flight(path: str) -> None:
+    """Pretty-print a flightrec_<reason>.json crash recording (pure-python
+    mirror of observability.flight.summarize_recording — this CLI stays
+    importable on analysis hosts without the accelerator runtime)."""
+    with open(path) as f:
+        payload = json.load(f)
+    print(f"flight recording: reason={payload.get('reason')} "
+          f"process={payload.get('process_index')} "
+          f"capacity={payload.get('capacity')} "
+          f"observed={payload.get('observed_records')}")
+    meta = payload.get("run_meta") or {}
+    if meta:
+        print(f"  run: api={meta.get('api')} model={meta.get('model')} "
+              f"world={meta.get('world_size')} comm_hook={meta.get('comm_hook')}")
+    notes = payload.get("notes") or {}
+    if notes:
+        print(f"  notes: {notes}")
+    records = payload.get("records") or {}
+    counts = payload.get("counts") or {}
+    print("  rings: " + ", ".join(
+        f"{k}={counts.get(k, 0)}" for k in sorted(counts)))
+    windows = records.get("step_stats") or []
+    if windows:
+        last = windows[-1]
+        print(f"  last window: epoch {last.get('epoch')} steps "
+              f"[{last.get('step_start')}, "
+              f"{(last.get('step_start') or 0) + (last.get('steps') or 0)}) "
+              f"p50 {_fmt(last.get('step_time_ms_p50'), 2)} ms")
+    epochs = records.get("epoch") or []
+    if epochs:
+        last = epochs[-1]
+        print(f"  last epoch: {last.get('epoch')} train "
+              f"{_fmt(last.get('train_loss'))} test {_fmt(last.get('test_loss'))}"
+              f" skips {last.get('skipped_steps_epoch', 0) or 0}")
+    events = records.get("event") or []
+    if events:
+        print(f"  events ({len(events)}):")
+        for ev in events:
+            fields = {
+                k: v for k, v in ev.items()
+                if k not in ("type", "schema_version", "event")
+            }
+            print(f"    [{ev.get('epoch', '-')}] {ev.get('event')}: {fields}")
 
 
 def summarize_bench(path: str) -> None:
@@ -331,6 +425,8 @@ def main(argv=None) -> int:
     kind = _detect_kind(args.path)
     if kind == "bench":
         errors, n = schema.validate_bench_file(args.path)
+    elif kind == "flight":
+        errors, n = schema.validate_flight_file(args.path)
     else:
         errors, n = schema.validate_history_file(args.path)
 
@@ -348,6 +444,8 @@ def main(argv=None) -> int:
 
     if kind == "bench":
         summarize_bench(args.path)
+    elif kind == "flight":
+        summarize_flight(args.path)
     elif args.events:
         for r in _read_history(args.path):
             if r.get("event"):

@@ -145,6 +145,75 @@ SERVING_DEFAULTS = {
 }
 
 
+# Live telemetry plane knobs (tpuddp/observability/{exporter,aggregate,
+# flight}.py) — the ``observability`` block of a settings file, consumed by
+# both training entrypoints, the serving engine, and tools/loadgen.py.
+# Same unknown-key-refusal contract as the ``training`` block.
+OBSERVABILITY_DEFAULTS = {
+    "exporter": False,  # opt-in /metrics + /healthz + /snapshot HTTP endpoint
+    # (observability/exporter.py): true serves on exporter_host:exporter_port;
+    # everything it publishes is host-side state the per-window fence already
+    # materialized — no new device fences, HLO untouched
+    "exporter_host": "127.0.0.1",  # bind address (0.0.0.0 to scrape off-host)
+    "exporter_port": 0,  # 0 = ephemeral; the bound port lands in
+    # <out_dir>/exporter.port and the run_meta observability header field
+    "aggregate": True,  # multi-host pod aggregation: each host publishes its
+    # last-window telemetry shard through the heartbeat-file channel
+    # (resilience/watchdog.py line 2); the main process merges shards into
+    # pod-level percentiles every window. Inert on single-process runs.
+    "straggler_ratio": 1.5,  # a host is straggling when its window step-time
+    # p50 exceeds ratio x the pod median ...
+    "straggler_windows": 3,  # ... for this many CONSECUTIVE fresh windows —
+    # then exactly one typed `straggler` event row lands in history.jsonl
+    "flight_recorder": True,  # bounded in-memory ring of the last N history
+    # records per kind (step_stats/event/epoch/serving_stats), dumped to
+    # flightrec_<reason>.json on abnormal exits (preempt 75 / watchdog 76 /
+    # desync 77 / unhandled exception / serving dispatch death)
+    "flight_capacity": 64,  # ring length per record kind
+}
+
+
+def observability_config(settings: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge the settings file's ``observability`` block over
+    :data:`OBSERVABILITY_DEFAULTS`, refusing unknown keys."""
+    return resolve_observability(settings.get("observability"))
+
+
+def resolve_observability(block) -> Dict[str, Any]:
+    """Resolve an ``observability`` block (None/bool/dict) to the full knob
+    dict. ``None``/``True`` -> defaults (exporter off, aggregation + flight
+    on); ``False`` -> the whole live plane off; a dict overrides the
+    defaults with unknown-key refusal. ``exporter`` itself accepts a dict
+    (``{host, port}``) as shorthand for the three exporter knobs."""
+    if block is None or block is True:
+        return dict(OBSERVABILITY_DEFAULTS)
+    if block is False:
+        off = dict(OBSERVABILITY_DEFAULTS)
+        off.update(exporter=False, aggregate=False, flight_recorder=False)
+        return off
+    if not isinstance(block, dict):
+        raise ValueError(
+            f"observability block must be a mapping or bool, got {block!r}"
+        )
+    block = dict(block)
+    exporter = block.get("exporter")
+    if isinstance(exporter, dict):
+        unknown = set(exporter) - {"host", "port"}
+        if unknown:
+            raise ValueError(
+                f"unknown observability.exporter key(s) {sorted(unknown)}; "
+                "expected host, port"
+            )
+        if "host" in exporter:
+            block.setdefault("exporter_host", exporter["host"])
+        if "port" in exporter:
+            block.setdefault("exporter_port", exporter["port"])
+        block["exporter"] = True
+    return _merge_refusing_unknown(
+        OBSERVABILITY_DEFAULTS, block, "observability"
+    )
+
+
 def _merge_refusing_unknown(defaults, overrides, block: str):
     """Defaults + overrides, refusing unknown keys with a did-you-mean hint —
     a typo'd knob silently ignored would run a different configuration than

@@ -20,8 +20,21 @@ stdout.
   on demand).
 - :mod:`telemetry` — :class:`RunTelemetry`, the bundle the epoch drivers
   wire through their hot loops.
+- :mod:`exporter`  — the opt-in live ``/metrics`` + ``/healthz`` +
+  ``/snapshot`` HTTP endpoint (ISSUE 10), fed by the recorder/serving state
+  the per-window fence already materialized.
+- :mod:`aggregate` — per-host telemetry shards over the heartbeat-file
+  channel + the main-process pod aggregator and straggler detector.
+- :mod:`flight`    — the bounded crash flight recorder, dumped to
+  ``flightrec_<reason>.json`` on abnormal exit paths.
 """
 
+from tpuddp.observability.aggregate import PodAggregator  # noqa: F401
+from tpuddp.observability.exporter import (  # noqa: F401
+    MetricsExporter,
+    exporter_from_config,
+)
+from tpuddp.observability.flight import FlightRecorder  # noqa: F401
 from tpuddp.observability.metrics import (  # noqa: F401
     CommBytesCounter,
     MetricsWriter,
@@ -57,7 +70,11 @@ from tpuddp.observability.telemetry import RunTelemetry  # noqa: F401
 
 __all__ = [
     "CommBytesCounter",
+    "FlightRecorder",
+    "MetricsExporter",
     "MetricsWriter",
+    "PodAggregator",
+    "exporter_from_config",
     "PEAK_FLOPS",
     "RECORD_TYPES",
     "RunTelemetry",

@@ -1,0 +1,322 @@
+"""Live /metrics exporter — an opt-in background HTTP endpoint.
+
+Everything tpuddp measures today is post-hoc: ``history.jsonl`` is read
+after the run, serving SLO windows only exist once flushed. The exporter
+makes the SAME numbers scrapeable while the run is alive, with the standing
+telemetry invariant intact: **zero new device fences**. Every value served
+here is host-side state the per-window fence (recorder) or the dispatch
+delivery path (serving stats) already materialized — a scrape reads dicts,
+never a device.
+
+Endpoints (ThreadingHTTPServer on a daemon thread; ``observability.exporter``
+config block, default OFF):
+
+- ``/metrics``  — Prometheus text exposition (gauges, counters, and
+  quantile-labeled summaries);
+- ``/healthz``  — ``{"status": "ok", "uptime_s": ...}`` liveness JSON;
+- ``/snapshot`` — the raw merged source dicts as JSON (the machine-readable
+  twin of /metrics, exact values, no text-format rounding).
+
+Sources are zero-arg callables returning ``{series_name: series}`` where a
+series is built with :func:`gauge`/:func:`counter`/:func:`summary`. The
+epoch drivers register the training telemetry source
+(``RunTelemetry.export_source``), the serving engine its SLO source
+(``ServingStats.export_source``), and the pod aggregator its per-host view —
+a failing source is dropped from that scrape with a warning, never a 500 for
+the other sources.
+
+``port=0`` binds an ephemeral port (tests, multi-tenant hosts); the bound
+port is republished in the run's ``run_meta.observability`` header field and
+— when a run dir is known — in ``<dir>/exporter.port`` so operators and the
+gate's scrape leg can find a live endpoint without parsing logs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from tpuddp.observability.metrics import json_sanitize
+
+logger = logging.getLogger("tpuddp")
+
+PORT_FILENAME = "exporter.port"
+_PREFIX = "tpuddp_"
+
+
+def gauge(value, help: str = "") -> dict:
+    """A point-in-time value (epoch, queue depth, occupancy)."""
+    return {"type": "gauge", "help": help, "value": value}
+
+
+def counter(value, help: str = "") -> dict:
+    """A monotonically-increasing total (steps, requests, bytes)."""
+    return {"type": "counter", "help": help, "value": value}
+
+
+def summary(quantiles: Dict[str, object], help: str = "", count=None) -> dict:
+    """A latency-style series: ``{"0.5": ms, "0.95": ms, ...}`` quantile
+    values (None entries are skipped at render time) plus an optional
+    observation count."""
+    return {
+        "type": "summary",
+        "help": help,
+        "quantiles": dict(quantiles),
+        "count": count,
+    }
+
+
+def _escape_label(value) -> str:
+    """Prometheus exposition label-value escaping: backslash, double quote,
+    and newline. Label values are caller-supplied strings (tenant ids!) —
+    one unescaped quote would make the WHOLE /metrics page unparseable."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt(value) -> Optional[str]:
+    """Prometheus sample value, or None to omit the sample (null metric)."""
+    if value is None or isinstance(value, bool):
+        return None
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return None
+    return repr(f) if f != int(f) else str(int(f))
+
+
+class MetricsExporter:
+    """The background endpoint. ``start()`` binds and serves; ``stop()``
+    tears down (idempotent, called from the drivers' ``finally``)."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        run_dir: Optional[str] = None,
+        port_filename: str = PORT_FILENAME,
+    ):
+        """``port_filename``: the discovery file's name inside ``run_dir``.
+        On a pod the run dir is SHARED — each process must publish under its
+        own name (``exporter_from_config`` qualifies non-zero processes as
+        ``exporter_p<i>.port``) or the file is last-writer-wins across hosts
+        and the first process to stop deletes it under its peers."""
+        self.host = host
+        self.requested_port = int(port)
+        self.port: Optional[int] = None  # bound port, known after start()
+        self.run_dir = run_dir
+        self.port_filename = port_filename
+        self._sources: Dict[str, Callable[[], dict]] = {}
+        self._lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = time.monotonic()
+        self.scrapes = 0
+
+    # ---------------------------------------------------------- sources --
+    def register_source(self, name: str, fn: Callable[[], dict]) -> None:
+        with self._lock:
+            self._sources[name] = fn
+
+    def unregister_source(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def collect(self) -> Dict[str, dict]:
+        """Merge every source's series; a failing source is skipped with a
+        warning (one broken feeder must not take the endpoint down)."""
+        with self._lock:
+            sources = list(self._sources.items())
+        merged: Dict[str, dict] = {}
+        for name, fn in sources:
+            try:
+                series = fn() or {}
+            except Exception as e:  # noqa: BLE001 — scrape must survive
+                logger.warning("exporter: source %r failed: %s", name, e)
+                continue
+            merged.update(series)
+        return merged
+
+    # --------------------------------------------------------- rendering --
+    def render_prometheus(self) -> str:
+        lines = []
+        for name, series in sorted(self.collect().items()):
+            full = name if name.startswith(_PREFIX) else _PREFIX + name
+            stype = series.get("type", "gauge")
+            if series.get("help"):
+                lines.append(f"# HELP {full} {series['help']}")
+            lines.append(f"# TYPE {full} {stype}")
+            if stype == "summary":
+                for q, v in series.get("quantiles", {}).items():
+                    s = _fmt(v)
+                    if s is not None:
+                        lines.append(
+                            f'{full}{{quantile="{_escape_label(q)}"}} {s}'
+                        )
+                c = _fmt(series.get("count"))
+                if c is not None:
+                    lines.append(f"{full}_count {c}")
+            else:
+                s = _fmt(series.get("value"))
+                if s is not None:
+                    labels = series.get("labels")
+                    if labels:
+                        lab = ",".join(
+                            f'{k}="{_escape_label(v)}"'
+                            for k, v in sorted(labels.items())
+                        )
+                        lines.append(f"{full}{{{lab}}} {s}")
+                    else:
+                        lines.append(f"{full} {s}")
+                for extra_labels, v in series.get("values", []):
+                    s = _fmt(v)
+                    if s is None:
+                        continue
+                    lab = ",".join(
+                        f'{k}="{_escape_label(val)}"'
+                        for k, val in sorted(extra_labels.items())
+                    )
+                    lines.append(f"{full}{{{lab}}} {s}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        return {
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "scrapes": self.scrapes,
+            "series": json_sanitize(self.collect()),
+        }
+
+    # --------------------------------------------------------- lifecycle --
+    def start(self) -> "MetricsExporter":
+        if self._server is not None:
+            return self
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # stdout silence: we have a logger
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    path = self.path.split("?", 1)[0]
+                    if path == "/metrics":
+                        exporter.scrapes += 1
+                        self._send(
+                            200,
+                            exporter.render_prometheus().encode(),
+                            "text/plain; version=0.0.4",
+                        )
+                    elif path == "/healthz":
+                        body = json.dumps({
+                            "status": "ok",
+                            "uptime_s": round(
+                                time.monotonic() - exporter._t0, 3
+                            ),
+                        }).encode()
+                        self._send(200, body, "application/json")
+                    elif path == "/snapshot":
+                        body = json.dumps(
+                            exporter.snapshot(), allow_nan=False
+                        ).encode()
+                        self._send(200, body, "application/json")
+                    else:
+                        self._send(404, b"not found\n", "text/plain")
+                except BrokenPipeError:
+                    pass  # client went away mid-response
+
+        self._server = ThreadingHTTPServer(
+            (self.host, self.requested_port), Handler
+        )
+        self._server.daemon_threads = True
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="tpuddp-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        self._write_port_file()
+        logger.info(
+            "exporter: /metrics /healthz /snapshot live on %s:%d",
+            self.host, self.port,
+        )
+        return self
+
+    def _write_port_file(self) -> None:
+        """Publish the bound port next to the run artifacts (atomic write) —
+        how operators and the gate's scrape leg discover an ephemeral port."""
+        if self.run_dir is None or self.port is None:
+            return
+        try:
+            os.makedirs(self.run_dir, exist_ok=True)
+            path = os.path.join(self.run_dir, self.port_filename)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(f"{self.port}\n")
+            os.replace(tmp, path)
+        except OSError as e:  # best-effort discovery aid, never fatal
+            logger.warning("exporter: port file write failed: %s", e)
+
+    def stop(self) -> None:
+        server, self._server = self._server, None
+        if server is None:
+            return
+        server.shutdown()
+        server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self.run_dir is not None:
+            try:
+                os.remove(os.path.join(self.run_dir, self.port_filename))
+            except OSError:
+                pass
+
+    def describe(self) -> dict:
+        """The run_meta ``observability.exporter`` provenance fields."""
+        return {"host": self.host, "port": self.port}
+
+
+def exporter_from_config(obs_cfg: dict, run_dir=None) -> Optional[MetricsExporter]:
+    """Build (not start) an exporter from a resolved ``observability`` config
+    block (tpuddp/config.py:OBSERVABILITY_DEFAULTS); None when disabled.
+
+    ``exporter: true`` serves on ``exporter_host:exporter_port``; the default
+    port 0 binds ephemerally and publishes the real port in
+    ``<run_dir>/exporter.port`` + the run_meta header."""
+    if not obs_cfg or not obs_cfg.get("exporter"):
+        return None
+    try:
+        import jax
+
+        process_index = jax.process_index()
+    except Exception:
+        process_index = 0
+    return MetricsExporter(
+        host=str(obs_cfg.get("exporter_host") or "127.0.0.1"),
+        port=int(obs_cfg.get("exporter_port") or 0),
+        run_dir=run_dir,
+        # per-process discovery file: the run dir is shared on a pod, and
+        # every host serves its own endpoint
+        port_filename=(
+            PORT_FILENAME
+            if process_index == 0
+            else f"exporter_p{process_index}.port"
+        ),
+    )

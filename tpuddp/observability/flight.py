@@ -1,0 +1,271 @@
+"""Crash flight recorder — a bounded in-memory ring dumped on abnormal exit.
+
+``history.jsonl`` already records everything, but on a crash the operator's
+first question is "what were the last few windows doing?" — answered today
+by scanning a possibly-huge file. The flight recorder keeps the LAST N
+records of each kind (step_stats windows, events, epoch rows,
+serving_stats) in memory, fed by the same tee every history write passes
+through (``MetricsWriter(flight=...)``) — so the rings hold exactly what the
+history flushed, plus the run_meta header, guard/comm context the epoch rows
+carry, and any ad-hoc ``note()`` fields. All host-side; nothing here ever
+touches a device.
+
+On an abnormal exit path the recorder dumps one strict-JSON artifact,
+``flightrec_<reason>.json``, atomically (tmp+rename) into the run dir:
+
+=================  ========================================================
+reason             exit path
+=================  ========================================================
+preempt            SIGTERM/SIGINT drain -> emergency checkpoint -> exit 75
+preempt_forced     the drain blew its grace window; failsafe forced exit 75
+watchdog           a peer's heartbeat went stale -> exit 76
+desync             the guard's auditor found a divergent replica -> exit 77
+exception          unhandled exception in either epoch driver
+serving_dispatch   the serving engine lost its last healthy replica
+=================  ========================================================
+
+``tools/tpuddp_inspect.py`` validates (schema.validate_flight_file) and
+pretty-prints recordings; ``tools/supervise.py`` summarizes the newest one
+before deciding restart/shrink. Dumps are idempotent per reason and
+best-effort by contract: a failing dump logs and returns None — the exit
+path that triggered it must proceed regardless.
+
+A module-level registry (:func:`install`/:func:`dump_all`) lets detached
+exit paths (the watchdog thread, the preemption failsafe) dump every live
+recorder without plumbing references through the resilience layer.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from tpuddp.observability import schema
+from tpuddp.observability.metrics import json_sanitize
+
+logger = logging.getLogger("tpuddp")
+
+DEFAULT_CAPACITY = 64
+
+# record types with their own ring; anything else (run_meta) is kept whole
+_RING_TYPES = ("step_stats", "event", "epoch", "serving_stats")
+
+_registry_lock = threading.Lock()
+_registry: List["FlightRecorder"] = []
+
+
+class FlightRecorder:
+    """Bounded per-process record rings + the atomic dump."""
+
+    def __init__(
+        self,
+        save_dir: Optional[str],
+        capacity: int = DEFAULT_CAPACITY,
+        process_index: Optional[int] = None,
+    ):
+        if process_index is None:
+            try:
+                import jax
+
+                process_index = jax.process_index()
+            except Exception:
+                process_index = 0
+        self.save_dir = save_dir
+        self.capacity = max(1, int(capacity))
+        self.process_index = int(process_index)
+        self._lock = threading.Lock()
+        self._rings: Dict[str, deque] = {
+            t: deque(maxlen=self.capacity) for t in _RING_TYPES
+        }
+        self._run_meta: Optional[dict] = None
+        self._notes: dict = {}
+        self.observed = 0
+        self.dumped: Dict[str, str] = {}  # reason -> path (idempotence)
+
+    # ------------------------------------------------------------- feeds --
+    def observe(self, record) -> None:
+        """Tee one history record into its ring (MetricsWriter calls this on
+        every write, BEFORE the process-0 file gate — every process keeps its
+        own recording). Unknown/untyped records are ignored."""
+        if not isinstance(record, dict):
+            return
+        rtype = record.get("type")
+        with self._lock:
+            self.observed += 1
+            if rtype == "run_meta":
+                self._run_meta = record  # newest header wins (elastic resume)
+            elif rtype in self._rings:
+                self._rings[rtype].append(record)
+
+    def note(self, **fields) -> None:
+        """Attach ad-hoc live context (last guard verdict, comm-byte
+        snapshot, in-flight depth) to the next dump."""
+        with self._lock:
+            self._notes.update(fields)
+
+    # -------------------------------------------------------------- dump --
+    def payload(self, reason: str) -> dict:
+        with self._lock:
+            records = {t: list(ring) for t, ring in self._rings.items()}
+            return json_sanitize({
+                "type": schema.FLIGHT_TYPE,
+                "schema_version": schema.SCHEMA_VERSION,
+                "reason": reason,
+                "process_index": self.process_index,
+                "capacity": self.capacity,
+                "dumped_at": round(time.time(), 3),
+                "observed_records": self.observed,
+                "counts": {t: len(r) for t, r in records.items()},
+                "run_meta": self._run_meta,
+                "notes": dict(self._notes),
+                "records": records,
+            })
+
+    def dump(self, reason: str) -> Optional[str]:
+        """Write ``flightrec_<reason>.json`` atomically; returns the path,
+        the previous path when this reason already dumped, or None (no
+        save_dir, or a failed best-effort write — logged, never raised).
+
+        Non-zero processes write ``flightrec_<reason>_p<i>.json``: on a pod
+        the save_dir is SHARED, and an unqualified name would be
+        last-rename-wins across hosts — one arbitrary recording surviving a
+        multi-host death instead of every process keeping its own."""
+        if self.save_dir is None:
+            return None
+        if reason in self.dumped:
+            return self.dumped[reason]
+        name = (
+            f"flightrec_{reason}.json"
+            if self.process_index == 0
+            else f"flightrec_{reason}_p{self.process_index}.json"
+        )
+        path = os.path.join(self.save_dir, name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(self.save_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(self.payload(reason), f, allow_nan=False, indent=1)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except (OSError, ValueError) as e:
+            logger.warning("flight recorder dump (%s) failed: %s", reason, e)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return None
+        self.dumped[reason] = path
+        logger.warning("flight recording (%s) -> %s", reason, path)
+        return path
+
+    def describe(self) -> dict:
+        """run_meta ``observability.flight_recorder`` provenance fields."""
+        return {"capacity": self.capacity}
+
+
+# ------------------------------------------------------------- registry --
+
+
+def install(recorder: FlightRecorder) -> FlightRecorder:
+    """Register a live recorder so detached exit paths (watchdog thread,
+    preemption failsafe) can dump it without holding a reference."""
+    with _registry_lock:
+        if recorder not in _registry:
+            _registry.append(recorder)
+    return recorder
+
+
+def uninstall(recorder: FlightRecorder) -> None:
+    with _registry_lock:
+        if recorder in _registry:
+            _registry.remove(recorder)
+
+
+def dump_all(reason: str) -> List[str]:
+    """Dump every installed recorder (best-effort, exception-free — callers
+    are exit paths that must proceed). Returns the written paths."""
+    with _registry_lock:
+        recorders = list(_registry)
+    paths = []
+    for rec in recorders:
+        try:
+            path = rec.dump(reason)
+        except Exception:  # noqa: BLE001 — never block an exit path
+            logger.exception("flight recorder dump_all(%r) failed", reason)
+            continue
+        if path:
+            paths.append(path)
+    return paths
+
+
+def find_recordings(directory: str) -> List[str]:
+    """``flightrec_*.json`` files in ``directory``, newest first (what
+    tools/supervise.py summarizes before deciding restart/shrink)."""
+    try:
+        names = [
+            n for n in os.listdir(directory)
+            if n.startswith("flightrec_") and n.endswith(".json")
+        ]
+    except OSError:
+        return []
+    paths = [os.path.join(directory, n) for n in names]
+    return sorted(paths, key=lambda p: os.path.getmtime(p), reverse=True)
+
+
+def summarize_recording(path: str) -> List[str]:
+    """Human-readable one-screen summary lines (shared by tpuddp_inspect and
+    the supervisor's pickup log). Tolerant of invalid files — the summary of
+    a corrupt recording says so instead of raising."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"flight recording {path}: unreadable ({e})"]
+    if not isinstance(payload, dict):
+        return [f"flight recording {path}: not a JSON object"]
+    lines = [
+        f"flight recording: reason={payload.get('reason')} "
+        f"process={payload.get('process_index')} "
+        f"capacity={payload.get('capacity')}"
+    ]
+    meta = payload.get("run_meta") or {}
+    if meta:
+        lines.append(
+            f"  run: api={meta.get('api')} model={meta.get('model')} "
+            f"world={meta.get('world_size')} epoch span "
+            f"{meta.get('start_epoch')}..{meta.get('num_epochs')}"
+        )
+    records = payload.get("records") or {}
+    windows = records.get("step_stats") or []
+    if windows:
+        last = windows[-1]
+        lines.append(
+            f"  last window: epoch {last.get('epoch')} steps "
+            f"[{last.get('step_start')}, "
+            f"{(last.get('step_start') or 0) + (last.get('steps') or 0)}) "
+            f"p50 {last.get('step_time_ms_p50')} ms "
+            f"({len(windows)} window(s) retained)"
+        )
+    epochs = records.get("epoch") or []
+    if epochs:
+        last = epochs[-1]
+        lines.append(
+            f"  last epoch: {last.get('epoch')} train_loss "
+            f"{last.get('train_loss')} skips "
+            f"{last.get('skipped_steps_epoch', 0)}"
+        )
+    events = records.get("event") or []
+    for ev in events[-5:]:
+        fields = {
+            k: v for k, v in ev.items()
+            if k not in ("type", "schema_version", "event")
+        }
+        lines.append(f"  event: {ev.get('event')} {fields}")
+    return lines

@@ -135,15 +135,23 @@ class MetricsWriter:
         save_dir: Optional[str],
         filename: str = "history.jsonl",
         main_only: bool = True,
+        flight=None,
     ):
+        """``flight``: an ``observability.flight.FlightRecorder`` tee — every
+        record passed to :meth:`write` is observed by the crash ring BEFORE
+        the process-0 file gate, so non-main processes keep a recording even
+        though they never write the file."""
         self.path = None
         self._f = None
         self._lock = threading.Lock()
+        self.flight = flight
         if save_dir is not None and (not main_only or jax.process_index() == 0):
             os.makedirs(save_dir, exist_ok=True)
             self.path = os.path.join(save_dir, filename)
 
     def write(self, record: dict) -> None:
+        if self.flight is not None:
+            self.flight.observe(record)
         if self.path is None:
             return
         # serialize the record OUTSIDE the lock (the expensive part), append

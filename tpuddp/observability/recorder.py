@@ -136,12 +136,18 @@ class StepStatsRecorder:
         capacity: int = 65536,
         flops_per_step: Optional[float] = None,
         peak_flops="auto",
+        on_window=None,
     ):
         """``peak_flops``: the chip ceiling for MFU — "auto" looks up the
         default device's kind; pass an explicit value (or None, a legitimate
         "unknown" for chips without a table entry) when the caller knows the
-        mesh's device better than the default platform does."""
+        mesh's device better than the default platform does.
+        ``on_window``: zero-arg callable invoked right after each window row
+        is emitted — the live telemetry plane's pump (shard publish +
+        pod aggregation, tpuddp/observability/aggregate.py); host-side only,
+        runs at the per-window fence that already exists."""
         self.writer = writer
+        self.on_window = on_window
         self.window = max(0, int(window or 0))
         self.capacity = int(capacity)
         self.flops_per_step = flops_per_step
@@ -151,6 +157,13 @@ class StepStatsRecorder:
         self._ring = np.zeros((self.capacity,), np.float64)
         self._n = 0  # total entries ever written (ring index = _n % capacity)
         self.global_step = 0  # train steps since loop entry (resume-relative)
+        # live-plane state: the last emitted step_stats record (what a
+        # /metrics scrape and the pod shard publish — both read-only, both
+        # matching the flushed history exactly) and run-cumulative counters
+        self.last_window: Optional[dict] = None
+        self.windows_emitted = 0
+        self.total_samples = 0
+        self.total_stall_s = 0.0
         self._epoch = 0
         self._epoch_start_n = 0
         self._epoch_samples = 0
@@ -210,8 +223,10 @@ class StepStatsRecorder:
         self.global_step += n_steps
         self._epoch_samples += int(n_samples)
         self._win_samples += int(n_samples)
+        self.total_samples += int(n_samples)
         self._epoch_stall += float(host_stall_s)
         self._win_stall += float(host_stall_s)
+        self.total_stall_s += float(host_stall_s)
         self._win_staging_max = max(self._win_staging_max, int(staging_depth))
         self._win_inflight_max = max(self._win_inflight_max, int(inflight_depth))
         self._last_t = now
@@ -254,6 +269,10 @@ class StepStatsRecorder:
         }
         if self.writer is not None:
             self.writer.write(schema.stamp("step_stats", record))
+        # the live plane reads exactly what the history flushed — a /metrics
+        # scrape can never disagree with history.jsonl beyond one window
+        self.last_window = record
+        self.windows_emitted += 1
         self._win_start_n = self._n
         self._win_start_step = self.global_step
         self._win_samples = 0
@@ -261,6 +280,44 @@ class StepStatsRecorder:
         self._win_staging_max = 0
         self._win_inflight_max = 0
         self._win_t0 = self._last_t
+        if self.on_window is not None:
+            self.on_window()
+
+    def live_snapshot(self) -> dict:
+        """Host-only live view for the exporter and the pod shard: cumulative
+        counters plus the LAST emitted window's percentiles (when the window
+        cadence is armed) or, without windows, percentiles over the newest
+        ring entries at dispatch resolution. Never touches a device — no
+        fence beyond the once-per-window one that already happened."""
+        snap = {
+            "epoch": self._epoch,
+            "step": self.global_step,
+            "samples_total": self.total_samples,
+            "host_stall_ms_total": round(self.total_stall_s * 1e3, 3),
+            "windows_emitted": self.windows_emitted,
+        }
+        if self.last_window is not None:
+            for k in (
+                "step_time_ms_p50", "step_time_ms_p95", "step_time_ms_p99",
+                "step_time_ms_max", "samples_per_sec", "mfu_p50",
+                "host_stall_ms",
+            ):
+                snap[k] = self.last_window.get(k)
+            snap["window"] = {
+                "epoch": self.last_window.get("epoch"),
+                "step_start": self.last_window.get("step_start"),
+                "steps": self.last_window.get("steps"),
+            }
+        else:
+            # no window cadence: percentiles over the newest entries, at the
+            # honest dispatch resolution (issue-time laps, not fenced)
+            tail = self._slice(max(self._epoch_start_n, self._n - 256))
+            snap.update(
+                step_time_fields(tail, self.flops_per_step, self.peak_flops)
+            )
+            snap["samples_per_sec"] = None
+            snap["window"] = None
+        return snap
 
     def epoch_summary(self) -> dict:
         """Percentile fields for the finished epoch's history row, then reset

@@ -29,10 +29,16 @@ fields to ``step_stats`` (``host_stall_ms``, ``inflight_depth``,
 ``comm_topology`` to ``run_meta`` (the comm-compression-v2 topology knob —
 flat vs hierarchical multi-hop reduction, parallel/comm.py; the header also
 gained the non-required ``comm_density`` / ``grad_comm_bytes_inter_host`` /
-``grad_comm_bytes_intra_host`` accounting fields). Readers accept every
-version up to their own ``SCHEMA_VERSION`` and reject newer files; the
-per-version required-field sets apply at the version each record CARRIES, so
-a v2 history (no occupancy fields) stays valid under a v4 reader.
+``grad_comm_bytes_intra_host`` accounting fields); v5 added the live
+telemetry plane's ``observability`` header field (exporter endpoint /
+pod-aggregation / flight-recorder provenance — a reader of a v5 history can
+tell whether a missing ``straggler`` event means "no straggler" or
+"aggregation was off") plus the ``straggler`` typed event and the
+``flight_recording`` sidecar artifact (``flightrec_<reason>.json``,
+:func:`validate_flight_payload`). Readers accept every version up to their
+own ``SCHEMA_VERSION`` and reject newer files; the per-version
+required-field sets apply at the version each record CARRIES, so a v2
+history (no occupancy fields) stays valid under a v5 reader.
 """
 
 from __future__ import annotations
@@ -42,7 +48,7 @@ import hashlib
 import json
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 RECORD_TYPES = ("run_meta", "epoch", "step_stats", "event", "serving_stats")
 
@@ -117,6 +123,13 @@ _REQUIRED_SINCE = {
     4: {
         "run_meta": ("comm_topology",),
     },
+    # v5: the live telemetry plane's provenance. The value may be null (a
+    # writer with the whole plane off) but the KEY must exist — absence is
+    # drift, and downstream consumers need to distinguish "no straggler
+    # events because all hosts were uniform" from "aggregation never ran".
+    5: {
+        "run_meta": ("observability",),
+    },
 }
 
 def stamp(record_type: str, record: dict) -> dict:
@@ -146,6 +159,7 @@ def make_run_meta(
     comm_hook: Optional[str] = None,
     comm_topology: Optional[str] = None,
     guard=None,
+    observability: Optional[dict] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Build the run_meta header row from live run objects.
@@ -187,6 +201,10 @@ def make_run_meta(
         # crossed (null = no comm configured, e.g. serving headers)
         "comm_topology": comm_topology,
         "guard": guard,
+        # required since schema v5: the live telemetry plane's provenance —
+        # exporter endpoint (bound port), pod aggregation + straggler knobs,
+        # flight recorder (null = the whole plane off, e.g. minimal headers)
+        "observability": observability,
     }
     if extra:
         record.update(extra)
@@ -311,4 +329,100 @@ def validate_bench_file(path: str) -> Tuple[List[str], int]:
         return [f"cannot parse {path}: {e}"], 0
     errors = validate_bench_payload(payload)
     n = len(payload.get("configs", {})) if isinstance(payload, dict) else 0
+    return errors, n
+
+
+# Flight recording (flightrec_<reason>.json) — the crash post-mortem sidecar
+# dumped by tpuddp/observability/flight.py on abnormal exit paths. ONE JSON
+# object: envelope fields plus per-category rings of ordinary history
+# records, so every ring entry validates with the same per-record rules the
+# history stream uses.
+FLIGHT_TYPE = "flight_recording"
+FLIGHT_REASONS = (
+    "preempt",          # SIGTERM/SIGINT drain (exit 75)
+    "preempt_forced",   # drain blew the grace window; failsafe forced exit 75
+    "watchdog",         # a peer's heartbeat went stale (exit 76)
+    "desync",           # the guard's auditor found a divergent replica (77)
+    "exception",        # unhandled exception in an epoch driver
+    "serving_dispatch", # the serving engine lost its last healthy replica
+)
+_FLIGHT_REQUIRED = (
+    "reason",
+    "process_index",
+    "capacity",
+    "counts",
+    "records",
+)
+_FLIGHT_RINGS = ("step_stats", "event", "epoch", "serving_stats")
+
+
+def validate_flight_payload(payload) -> List[str]:
+    """Schema errors for a flight-recording payload (empty = valid)."""
+    if not isinstance(payload, dict):
+        return ["flight payload is not a JSON object"]
+    errors = []
+    if payload.get("type") != FLIGHT_TYPE:
+        errors.append(
+            f"'type' must be {FLIGHT_TYPE!r}, got {payload.get('type')!r}"
+        )
+    version = payload.get("schema_version")
+    if not isinstance(version, int) or version < 5:
+        errors.append(
+            f"schema_version {version!r} is not an int >= 5 (flight "
+            "recordings were introduced at v5)"
+        )
+    elif version > SCHEMA_VERSION:
+        errors.append(
+            f"schema_version {version} is newer than this reader's "
+            f"{SCHEMA_VERSION}"
+        )
+    errors += [f"missing field {k!r}" for k in _FLIGHT_REQUIRED if k not in payload]
+    reason = payload.get("reason")
+    if "reason" in payload and reason not in FLIGHT_REASONS:
+        errors.append(
+            f"unknown reason {reason!r}; expected one of {FLIGHT_REASONS}"
+        )
+    records = payload.get("records")
+    if records is not None:
+        if not isinstance(records, dict):
+            errors.append("'records' must be an object of ring -> [records]")
+        else:
+            for ring in _FLIGHT_RINGS:
+                entries = records.get(ring, [])
+                if not isinstance(entries, list):
+                    errors.append(f"ring {ring!r} is not a list")
+                    continue
+                for i, rec in enumerate(entries):
+                    for e in validate_record(rec, i):
+                        errors.append(f"ring {ring!r}: {e}")
+                    if isinstance(rec, dict) and rec.get("type") != ring:
+                        errors.append(
+                            f"ring {ring!r} record {i}: type "
+                            f"{rec.get('type')!r} does not belong in this ring"
+                        )
+    run_meta = payload.get("run_meta")
+    if run_meta is not None:
+        for e in validate_record(run_meta, 0):
+            errors.append(f"run_meta: {e}")
+    return errors
+
+
+def validate_flight_file(path: str) -> Tuple[List[str], int]:
+    """Parse + validate a flight recording. Returns (errors, n_ring_records);
+    non-strict JSON (bare NaN/Infinity) is itself a schema error."""
+
+    def _reject(token):
+        raise ValueError(f"non-strict JSON token {token}")
+
+    try:
+        with open(path) as f:
+            payload = json.load(f, parse_constant=_reject)
+    except (OSError, ValueError) as e:
+        return [f"cannot parse {path}: {e}"], 0
+    errors = validate_flight_payload(payload)
+    n = 0
+    if isinstance(payload, dict) and isinstance(payload.get("records"), dict):
+        n = sum(
+            len(v) for v in payload["records"].values() if isinstance(v, list)
+        )
     return errors, n

@@ -12,6 +12,13 @@ driver's ``finally``. Everything is host-side: the compiled step program is
 never touched (telemetry on/off lowers to the identical HLO), no collectives
 are added, and the only device syncs are the per-window fence and the
 profiler's end-of-window flush.
+
+The live telemetry plane (ISSUE 10) attaches here too: ``attach_live``
+wires the /metrics exporter (this run's gauges/counters/summaries), the
+telemetry-shard publisher (this host's last window into the heartbeat
+channel), and the main-process pod aggregator — all pumped at the
+per-window boundary the recorder already fences, so "exporter + aggregator
+on" adds zero device syncs and zero collectives.
 """
 
 from __future__ import annotations
@@ -81,7 +88,126 @@ class RunTelemetry:
         self._flops_probed = False
         self._epoch_trace = False
         self._last_fence = None
+        # live plane (attach_live): exporter/aggregator/shard channel plus
+        # driver-updated gauges (skip counters, comm bytes, last losses)
+        self.exporter = None
+        self.aggregator = None
+        self._shard_dir = None
+        self._shard_pid = 0
+        self.live: dict = {}
+        self.recorder.on_window = self._on_window
         profiling.install_sigusr1_trigger()
+
+    # -- live telemetry plane (ISSUE 10) -----------------------------------
+
+    def attach_live(
+        self,
+        exporter=None,
+        aggregator=None,
+        shard_dir=None,
+        process_id: int = 0,
+    ) -> None:
+        """Wire the live plane: ``exporter`` gets this run's training source,
+        ``shard_dir`` arms per-window shard publishing into the heartbeat
+        channel (also registered as the watchdog beat's payload so liveness
+        rewrites carry the freshest shard), ``aggregator`` (main process) is
+        pumped at every window boundary. All host-side, all at the existing
+        per-window cadence — no new fences."""
+        self.exporter = exporter
+        self.aggregator = aggregator
+        self._shard_dir = shard_dir
+        self._shard_pid = int(process_id)
+        if exporter is not None:
+            exporter.register_source("training", self.export_source())
+            if aggregator is not None:
+                exporter.register_source("pod", aggregator.export_source())
+        if shard_dir is not None:
+            from tpuddp.resilience import watchdog as wd
+
+            wd.set_heartbeat_payload(self._shard)
+
+    def update_live(self, **fields) -> None:
+        """Driver-side live gauges the recorder cannot see (guard skip
+        totals, comm bytes, last epoch losses) — merged into the exporter's
+        training source and the published shard."""
+        self.live.update(fields)
+
+    def _shard(self):
+        from tpuddp.observability import aggregate
+
+        return aggregate.make_shard(
+            self.recorder.live_snapshot(),
+            skipped_steps=self.live.get("skipped_steps") or 0,
+        )
+
+    def _on_window(self) -> None:
+        """Recorder window-boundary pump: publish this host's shard, merge
+        the pod view (main process). The window fence already happened —
+        this is file IO + arithmetic only."""
+        if self._shard_dir is not None:
+            from tpuddp.observability import aggregate
+
+            aggregate.publish_shard(
+                self._shard_dir, self._shard_pid, self._shard()
+            )
+        if self.aggregator is not None:
+            self.aggregator.update()
+
+    def export_source(self):
+        """The exporter's training source: cumulative counters + the last
+        emitted window's percentiles (exactly what history.jsonl flushed)."""
+        from tpuddp.observability import exporter as exp
+
+        def source():
+            live = self.recorder.live_snapshot()
+            series = {
+                "train_steps_total": exp.counter(
+                    live.get("step"), "train steps since loop entry"
+                ),
+                "train_samples_total": exp.counter(
+                    live.get("samples_total"), "global samples dispatched"
+                ),
+                "epoch": exp.gauge(live.get("epoch"), "current epoch"),
+                "step_time_ms": exp.summary(
+                    {
+                        "0.5": live.get("step_time_ms_p50"),
+                        "0.95": live.get("step_time_ms_p95"),
+                        "0.99": live.get("step_time_ms_p99"),
+                        "1.0": live.get("step_time_ms_max"),
+                    },
+                    "last-window per-step wall time",
+                ),
+                "train_samples_per_sec": exp.gauge(
+                    live.get("samples_per_sec"), "last-window throughput"
+                ),
+                "mfu": exp.gauge(
+                    live.get("mfu_p50"), "last-window achieved MFU at p50"
+                ),
+                "host_stall_ms_total": exp.counter(
+                    live.get("host_stall_ms_total"),
+                    "cumulative host-blocked time",
+                ),
+                "step_stats_windows_total": exp.counter(
+                    live.get("windows_emitted"), "step_stats rows flushed"
+                ),
+            }
+            for key, help_text in (
+                ("skipped_steps", "guard-skipped updates (total)"),
+                ("grad_comm_bytes_total", "gradient bytes on the wire"),
+                ("train_loss", "last completed epoch train loss"),
+                ("test_loss", "last completed epoch test loss"),
+                ("test_accuracy", "last completed epoch test accuracy (%)"),
+            ):
+                if key in self.live:
+                    kind = (
+                        exp.counter
+                        if key in ("skipped_steps", "grad_comm_bytes_total")
+                        else exp.gauge
+                    )
+                    series[key] = kind(self.live[key], help_text)
+            return series
+
+        return source
 
     def offer_batch(self, host_batch) -> None:
         """Capture the abstract (shape, dtype) structure of one host batch —
@@ -163,6 +289,17 @@ class RunTelemetry:
 
     def finish(self) -> None:
         """Driver ``finally``: flush any partial step-window trace (it is the
-        post-mortem artifact) and release the trace latch."""
+        post-mortem artifact), release the trace latch, and detach the live
+        plane (heartbeat shards must not outlive the telemetry they carry)."""
         self.window_profiler.finish(self._last_fence)
         self.stop_epoch_trace()
+        if self._shard_dir is not None:
+            from tpuddp.resilience import watchdog as wd
+
+            wd.set_heartbeat_payload(None)
+            self._shard_dir = None
+        if self.exporter is not None:
+            self.exporter.unregister_source("training")
+            self.exporter.unregister_source("pod")
+            self.exporter = None
+        self.aggregator = None

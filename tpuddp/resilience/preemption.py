@@ -99,6 +99,32 @@ def _failsafe(grace: float) -> None:
             grace,
             EXIT_PREEMPTED,
         )
+        try:
+            # a drain that WEDGED is exactly when a post-mortem matters:
+            # dump whatever the flight rings hold before the forced exit
+            # skips every finally. BOUNDED by contract: the dump writes to
+            # the same (possibly wedged) shared filesystem the drain hung
+            # on, and a hang is not an exception — so it runs on a daemon
+            # side thread with a short join, and the forced exit proceeds
+            # regardless. The failsafe's whole job is to beat the SIGKILL;
+            # it must never trade that for a post-mortem.
+            from tpuddp.observability import flight
+
+            t = threading.Thread(
+                target=flight.dump_all,
+                args=("preempt_forced",),
+                name="tpuddp-flight-forced",
+                daemon=True,
+            )
+            t.start()
+            t.join(timeout=5.0)
+            if t.is_alive():
+                logger.critical(
+                    "forced-exit flight dump is wedged too (shared FS?); "
+                    "exiting without it"
+                )
+        except Exception:
+            logger.exception("forced-exit flight dump failed")
         os._exit(EXIT_PREEMPTED)
 
 

@@ -111,7 +111,14 @@ class RestartSupervisor:
         runner: Optional[Callable[[Sequence[str], Dict[str, str]], int]] = None,
         sleep: Callable[[float], None] = time.sleep,
         rng: Optional[random.Random] = None,
+        flight_dir: Optional[str] = None,
     ):
+        """``flight_dir``: where the supervised run dumps its crash flight
+        recordings (``flightrec_<reason>.json`` — usually the run's
+        out_dir). When set, the supervisor summarizes the newest recording
+        at startup (a previous run's post-mortem) and after every abnormal
+        child exit, BEFORE deciding restart/shrink — the operator sees what
+        the child was doing when it died, not just the exit code."""
         self.argv = list(argv)
         self.policy = policy or SupervisorPolicy()
         self.world_size = int(world_size) if world_size else None
@@ -121,6 +128,8 @@ class RestartSupervisor:
         self.runner = runner or _run_subprocess
         self.sleep = sleep
         self._rng = rng or random.Random()
+        self.flight_dir = flight_dir
+        self._summarized: set = set()  # (path, mtime) pairs already logged
         # (attempt_index, exit_code, world_size) per child run — the
         # supervisor's own post-mortem trail (tests assert against it)
         self.history: List[Tuple[int, int, Optional[int]]] = []
@@ -146,12 +155,39 @@ class RestartSupervisor:
             env[WORLD_ENV] = str(self.world_size)
         return env
 
+    # ---------------------------------------------------------- flight --
+    def summarize_flight(self) -> int:
+        """Log the crash flight recordings in ``flight_dir`` not yet
+        summarized (newest first); returns how many were. Best-effort: a
+        missing dir or corrupt recording logs and moves on — the restart
+        decision never blocks on the post-mortem."""
+        if self.flight_dir is None:
+            return 0
+        from tpuddp.observability import flight as flight_lib
+
+        summarized = 0
+        for path in flight_lib.find_recordings(self.flight_dir):
+            try:
+                key = (path, os.path.getmtime(path))
+            except OSError:
+                continue
+            if key in self._summarized:
+                continue
+            self._summarized.add(key)
+            summarized += 1
+            for line in flight_lib.summarize_recording(path):
+                logger.warning("supervisor: %s", line)
+        return summarized
+
     # ------------------------------------------------------------------ run --
     def run(self) -> int:
         restarts = 0
         consecutive_failures = 0  # backoff exponent (resets on 75)
         consecutive_peer_deaths = 0  # shrink trigger (exit-76 streak)
         attempt = 0
+        # a previous (unsupervised) run may have left its post-mortem here —
+        # surface it before the first attempt
+        self.summarize_flight()
         while True:
             rc = self.runner(self.argv, self._child_env(attempt))
             self.history.append((attempt, rc, self.world_size))
@@ -159,6 +195,9 @@ class RestartSupervisor:
             if rc == 0:
                 logger.info("supervisor: child finished cleanly")
                 return 0
+            # the child died abnormally: read its flight recording(s) before
+            # deciding how (and at what world size) to restart
+            self.summarize_flight()
             restarts += 1
             if restarts > self.policy.max_restarts:
                 logger.critical(

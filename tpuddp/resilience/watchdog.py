@@ -21,10 +21,21 @@ is already the shared-filesystem rendezvous point on pods);
 ``$TPUDDP_HEARTBEAT_DIR`` overrides.  A ``hang`` fault (faults.is_hung) stops
 the beat without stopping the process — the injected hang is indistinguishable
 from a dead peer, which is the point of the chaos test.
+
+The heartbeat file doubles as the **telemetry shard channel** (ISSUE 10,
+tpuddp/observability/aggregate.py): line 1 stays the wall-clock timestamp
+(the liveness contract, unchanged), and an optional line 2 carries one JSON
+object — the host's last-window step-time/stall/skip shard. Writers pass
+``payload=`` (or register :func:`set_heartbeat_payload` so the beat thread
+carries the freshest shard on every rewrite); readers use
+:func:`read_heartbeat_payload`, which skips a torn mid-write line with a
+warning instead of ever crashing the aggregator. ``read_heartbeat`` parses
+line 1 only, so liveness checks are indifferent to the payload.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import re
@@ -82,7 +93,14 @@ def purge_stale_peers(directory: str, num_processes: int) -> int:
     ``<save_dir>/.heartbeats``: the old world's extra ``hb_{i}`` files are
     forever-stale by definition, and any watchdog that trusted them would
     kill the healthy smaller run with exit 76. Best-effort (a peer may purge
-    the same file concurrently); returns the number removed."""
+    the same file concurrently); returns the number removed.
+
+    Scope contract (ISSUE 10): ONLY ids past the current world are removed.
+    ``hb_{i < num_processes}`` files — including the telemetry shard payload
+    on their second line — belong to live peers of THIS world and must
+    survive the purge: a blanket clean-slate delete here would race a peer's
+    first shard publish and silently blind the pod aggregator on every
+    elastic resume."""
     try:
         names = os.listdir(directory)
     except OSError:
@@ -105,20 +123,80 @@ def purge_stale_peers(directory: str, num_processes: int) -> int:
     return removed
 
 
-def write_heartbeat(directory: str, process_id: int, now: Optional[float] = None) -> str:
+def write_heartbeat(
+    directory: str,
+    process_id: int,
+    now: Optional[float] = None,
+    payload: Optional[dict] = None,
+) -> str:
+    """Atomically rewrite this process's liveness file: timestamp line plus,
+    when given, one JSON telemetry-shard line (the aggregation channel).
+    The tmp+replace means a reader sees the old whole file or the new whole
+    file — a *torn* payload can only come from a non-atomic filesystem, and
+    the payload reader tolerates that too."""
     path = _hb_path(directory, process_id)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         f.write(f"{time.time() if now is None else now:.6f}\n")
+        if payload is not None:
+            f.write(json.dumps(payload, allow_nan=False) + "\n")
     os.replace(tmp, path)
     return path
 
 
 def read_heartbeat(directory: str, process_id: int) -> Optional[float]:
+    """The peer's last beat timestamp (line 1 ONLY — a telemetry shard on
+    line 2 must never make a live peer read as dead)."""
     try:
         with open(_hb_path(directory, process_id)) as f:
-            return float(f.read().strip())
+            return float(f.readline().strip())
     except (OSError, ValueError):
+        return None
+
+
+def read_heartbeat_payload(directory: str, process_id: int) -> Optional[dict]:
+    """The peer's telemetry shard (line 2), or None: no file, no payload
+    line, or a torn/partial JSON line — the last is skipped with a warning,
+    never an exception (the aggregator's tolerance contract, ISSUE 10)."""
+    try:
+        with open(_hb_path(directory, process_id)) as f:
+            f.readline()  # the timestamp line
+            raw = f.readline().strip()
+    except OSError:
+        return None
+    if not raw:
+        return None
+    try:
+        shard = json.loads(raw)
+    except ValueError:
+        logger.warning(
+            "heartbeat shard for process %d is torn mid-write; skipping "
+            "this read (the next rewrite heals it)",
+            process_id,
+        )
+        return None
+    return shard if isinstance(shard, dict) else None
+
+
+# The beat thread's shard feed: a zero-arg callable returning the freshest
+# telemetry payload (or None). Module-level because the Heartbeat starts in
+# spawn BEFORE the epoch driver builds its telemetry — RunTelemetry registers
+# here once it exists, and every subsequent beat carries the shard.
+_payload_fn = {"fn": None}
+
+
+def set_heartbeat_payload(fn: Optional[Callable[[], Optional[dict]]]) -> None:
+    _payload_fn["fn"] = fn
+
+
+def _current_payload() -> Optional[dict]:
+    fn = _payload_fn["fn"]
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception as e:  # noqa: BLE001 — liveness must outlive telemetry
+        logger.warning("heartbeat payload callback failed: %s", e)
         return None
 
 
@@ -146,7 +224,13 @@ class Heartbeat:
             if faults.is_hung():
                 continue  # injected hang: look exactly like a dead peer
             try:
-                write_heartbeat(self.directory, self.process_id)
+                # each beat carries the freshest telemetry shard (if a
+                # publisher registered one) — liveness and aggregation ride
+                # the same atomic rewrite
+                write_heartbeat(
+                    self.directory, self.process_id,
+                    payload=_current_payload(),
+                )
             except OSError as e:  # shared FS hiccup: log, keep beating
                 logger.warning("heartbeat write failed: %s", e)
 
@@ -331,6 +415,14 @@ class Watchdog:
                 self.event_writer.sync()
             except Exception:
                 logger.exception("watchdog event record failed")
+        # the crash flight recorder's exit-76 dump: the last windows/events
+        # this process saw before it stopped waiting on the dead peer
+        try:
+            from tpuddp.observability import flight
+
+            flight.dump_all("watchdog")
+        except Exception:
+            logger.exception("watchdog flight dump failed")
         if callable(self.action):
             self.action(stale)
         elif self.action == "raise":

@@ -16,6 +16,11 @@ Modes:
   "draining"), in-flight and queued work completes, stats flush, and the
   process exits 75 (``EXIT_PREEMPTED``) — the resilience exit-code contract,
   so schedulers requeue a drained server exactly like a drained trainer.
+  Combined with ``--demo N``, the demo traffic runs FIRST and the engine
+  then stays up for the serve window — the live-ops shape: populate the SLO
+  windows, then scrape ``/metrics`` against a running engine (the
+  ``observability.exporter`` block arms the endpoint; the bound port lands
+  in ``<out_dir>/exporter.port``).
 
 Stdout contract: the LAST line is one compact JSON object (the SLO summary)
 for driver parsing, mirroring bench.py's output contract.
@@ -68,25 +73,32 @@ def main(argv=None) -> int:
         "--tenants", type=int, default=2, help="demo-mode tenant count",
     )
     args = parser.parse_args(argv)
-    if (args.demo is None) == (args.serve is None):
-        parser.error("exactly one of --demo N / --serve S is required")
+    if args.demo is None and args.serve is None:
+        parser.error("at least one of --demo N / --serve S is required")
 
     settings = config_lib.load_settings(args.settings)
     serving = config_lib.serving_config(settings)
+    observability = config_lib.observability_config(settings)
     out_dir = settings.get("out_dir")
     if out_dir:
         out_dir = config_lib.prepare_out_dir(settings, args.settings)
 
-    engine = ServingEngine.from_config(serving, out_dir=out_dir)
+    engine = ServingEngine.from_config(
+        serving, out_dir=out_dir, observability=observability
+    )
     engine.start()
 
     if args.demo is not None:
         results = _demo_requests(engine, args.demo, max(1, args.tenants))
         for r in results:
             r.result(timeout=120)
-        summary = engine.drain(reason="demo_complete")
-        print(json.dumps(json_sanitize(summary), allow_nan=False))
-        return 0
+        if args.serve is None:
+            summary = engine.drain(reason="demo_complete")
+            print(json.dumps(json_sanitize(summary), allow_nan=False))
+            return 0
+        # --demo + --serve: keep the warm, traffic-populated engine up for
+        # the serve window (the live-ops scrape target)
+        print("demo traffic complete; serving", flush=True)
 
     # --serve: SIGTERM/SIGINT -> resilience drain contract (exit 75)
     preemption.install_preemption_handler()

@@ -49,21 +49,44 @@ class ServingEngine:
         out_dir: Optional[str] = None,
         config: Optional[dict] = None,
         unhealthy_after: int = 3,
+        observability: Optional[dict] = None,
     ):
         """``unhealthy_after``: K consecutive dispatch errors mark a replica
         unhealthy — its loop stops pulling work (a broken device/program no
         longer fails every batch routed to it) and a ``replica_unhealthy``
         event row lands in history.jsonl; healthy replicas keep serving and
         drain still exits cleanly. 0 disables the marking (legacy behavior:
-        each batch on the broken replica fails individually, forever)."""
+        each batch on the broken replica fails individually, forever).
+
+        ``observability``: the live-plane block (config.OBSERVABILITY_DEFAULTS
+        shape): ``exporter: true`` serves /metrics from the SLO stats (last
+        flushed window + cumulative counters — host dicts only, the dispatch
+        hot path is untouched); the flight recorder tees every history record
+        and dumps ``flightrec_serving_dispatch.json`` if the engine ever
+        loses its last healthy replica."""
+        from tpuddp import config as cfg_lib
+        from tpuddp.observability import exporter as exp_lib
+        from tpuddp.observability import flight as flight_lib
+
         self.pool = pool
         self.queue = RequestQueue(max_queue_depth, per_tenant_quota)
         self.scheduler = BatchScheduler(
             self.queue, max_batch_size, batch_timeout_ms
         )
         self.unhealthy_after = int(unhealthy_after or 0)
-        self.writer = MetricsWriter(out_dir) if out_dir else None
+        self._obs_cfg = cfg_lib.resolve_observability(observability)
+        self.flight = None
+        if self._obs_cfg["flight_recorder"] and out_dir:
+            self.flight = flight_lib.install(flight_lib.FlightRecorder(
+                out_dir, capacity=int(self._obs_cfg["flight_capacity"]),
+            ))
+        self.writer = (
+            MetricsWriter(out_dir, flight=self.flight) if out_dir else None
+        )
         self.stats = ServingStats(self.writer, window=stats_window)
+        self.exporter = exp_lib.exporter_from_config(
+            self._obs_cfg, run_dir=out_dir
+        )
         self._config = dict(config or {})
         self._threads: List[threading.Thread] = []
         self._started = False
@@ -71,10 +94,12 @@ class ServingEngine:
 
     @classmethod
     def from_config(
-        cls, cfg: dict, out_dir: Optional[str] = None, devices=None
+        cls, cfg: dict, out_dir: Optional[str] = None, devices=None,
+        observability: Optional[dict] = None,
     ) -> "ServingEngine":
         """Build pool + engine from a ``serving`` config block
-        (tpuddp/config.py:SERVING_DEFAULTS / serving_config)."""
+        (tpuddp/config.py:SERVING_DEFAULTS / serving_config); the optional
+        ``observability`` block arms the exporter/flight recorder."""
         pool = ReplicaPool.from_config(cfg, devices=devices)
         quota = cfg.get("per_tenant_quota")
         return cls(
@@ -87,12 +112,19 @@ class ServingEngine:
             out_dir=out_dir,
             config=cfg,
             unhealthy_after=int(cfg.get("unhealthy_after", 3) or 0),
+            observability=observability,
         )
 
     # ------------------------------------------------------------- lifecycle --
     def start(self, warmup: bool = True) -> "ServingEngine":
         if self._started:
             return self
+        if self.exporter is not None:
+            # bind before the header so run_meta records the real port
+            self.exporter.start()
+            self.exporter.register_source(
+                "serving", self.stats.export_source(engine=self)
+            )
         if self.writer is not None:
             cfg = self._config
             self.writer.write(
@@ -100,6 +132,19 @@ class ServingEngine:
                     world_size=len(self.pool),
                     comm_hook=None,
                     guard=None,
+                    observability={
+                        "exporter": (
+                            self.exporter.describe()
+                            if self.exporter is not None
+                            else False
+                        ),
+                        "aggregate": False,  # no pod axis on the serving path
+                        "flight_recorder": (
+                            self.flight.describe()
+                            if self.flight is not None
+                            else False
+                        ),
+                    },
                     extra={
                         "api": "serving",
                         "model": cfg.get("model"),
@@ -179,6 +224,12 @@ class ServingEngine:
                     )
                 )
                 self.writer.close()
+            if self.exporter is not None:
+                self.exporter.stop()
+            if self.flight is not None:
+                from tpuddp.observability import flight as flight_lib
+
+                flight_lib.uninstall(self.flight)
         return self.stats.summary()
 
     # --------------------------------------------------------------- client --
@@ -296,6 +347,10 @@ class ServingEngine:
                         "serving: NO healthy replicas remain; failing queued "
                         "requests instead of hanging the drain"
                     )
+                    if self.flight is not None:
+                        # serving dispatch death: the last windows + the
+                        # dispatch-error/unhealthy events are in the ring
+                        self.flight.dump("serving_dispatch")
                 continue
             replica.consecutive_errors = 0
             t_done = time.perf_counter()

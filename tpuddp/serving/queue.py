@@ -191,6 +191,12 @@ class RequestQueue:
             lane = self._lanes.get(tenant)
             return len(lane) if lane else 0
 
+    def tenant_depths(self) -> dict:
+        """Current queued-request count per tenant lane (the exporter's
+        per-tenant queue-depth gauge) — one lock hold for the whole view."""
+        with self._cond:
+            return {t: len(lane) for t, lane in self._lanes.items() if lane}
+
     # ------------------------------------------------------------ draining --
     def _assemble(
         self, max_rows: int, key=None

@@ -77,6 +77,10 @@ class ServingStats:
             completed=0, submitted=0, rejected=0, batches=0, rows=0,
             bucket_rows=0,
         )
+        # live-plane state: the last emitted serving_stats record — what a
+        # /metrics scrape serves, so live values can never disagree with the
+        # flushed history beyond one window
+        self.last_window: Optional[dict] = None
 
     # ------------------------------------------------------------ recording --
     def reset_clock(self) -> None:
@@ -158,6 +162,7 @@ class ServingStats:
         }
         if self.writer is not None:
             self.writer.write(schema.stamp("serving_stats", record))
+        self.last_window = record
         self._win_index += 1
         self._win_t0 = now
         self._win_queue_ms = []
@@ -234,6 +239,96 @@ class ServingStats:
                     self._lat_dropped - mark.get("dropped", 0)
                 ),
             }
+
+    # ----------------------------------------------------------- exporter --
+    def export_source(self, engine=None):
+        """The /metrics exporter's serving source: cumulative counters plus
+        the LAST flushed window's latency/throughput/occupancy (exactly the
+        serving_stats row history.jsonl holds). ``engine`` (optional) adds
+        live queue depth, per-tenant lanes, and healthy-replica gauges. All
+        lock-guarded host dict reads — the dispatch hot path is untouched."""
+        from tpuddp.observability import exporter as exp
+
+        def source():
+            with self._lock:
+                completed = self.completed
+                submitted = self.submitted
+                rejected = sum(self.rejects.values())
+                rows = self.completed_rows
+                batches = self.batches
+                per_tenant = dict(self.per_tenant_completed)
+                win = dict(self.last_window) if self.last_window else None
+            series = {
+                "serving_requests_total": exp.counter(
+                    submitted, "requests submitted"
+                ),
+                "serving_completed_total": exp.counter(
+                    completed, "requests completed"
+                ),
+                "serving_rejected_total": exp.counter(
+                    rejected, "requests rejected at admission"
+                ),
+                "serving_rows_total": exp.counter(rows, "sample rows served"),
+                "serving_batches_total": exp.counter(
+                    batches, "device batches dispatched"
+                ),
+            }
+            if per_tenant:
+                series["serving_tenant_completed_total"] = {
+                    "type": "counter",
+                    "help": "completed requests by tenant",
+                    "values": [
+                        ({"tenant": t}, n) for t, n in sorted(per_tenant.items())
+                    ],
+                }
+            if win is not None:
+                series.update({
+                    "serving_e2e_ms": exp.summary(
+                        {
+                            "0.5": win.get("e2e_ms_p50"),
+                            "0.95": win.get("e2e_ms_p95"),
+                            "0.99": win.get("e2e_ms_p99"),
+                        },
+                        "last-window end-to-end latency",
+                        count=win.get("completed"),
+                    ),
+                    "serving_queue_ms": exp.summary(
+                        {"0.5": win.get("queue_ms_p50")},
+                        "last-window scheduling + coalescing wait",
+                    ),
+                    "serving_device_ms": exp.summary(
+                        {"0.5": win.get("device_ms_p50")},
+                        "last-window device + fetch time",
+                    ),
+                    "serving_throughput_rps": exp.gauge(
+                        win.get("throughput_rps"), "last-window requests/sec"
+                    ),
+                    "serving_batch_occupancy": exp.gauge(
+                        win.get("batch_occupancy"),
+                        "last-window real rows / padded bucket rows",
+                    ),
+                })
+            if engine is not None:
+                series["serving_queue_depth"] = exp.gauge(
+                    engine.queue.depth(), "requests queued right now"
+                )
+                tenant_depths = engine.queue.tenant_depths()
+                if tenant_depths:
+                    series["serving_tenant_queue_depth"] = {
+                        "type": "gauge",
+                        "help": "queued requests by tenant lane",
+                        "values": [
+                            ({"tenant": t}, n)
+                            for t, n in sorted(tenant_depths.items())
+                        ],
+                    }
+                series["serving_replicas_healthy"] = exp.gauge(
+                    sum(1 for r in engine.pool.replicas if r.healthy),
+                    "replicas still routed to",
+                )
+            return series
+
+        return source
 
     # -------------------------------------------------------------- summary --
     def summary(self) -> dict:

@@ -18,6 +18,7 @@ Quirk decisions (SURVEY.md §3.5):
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Optional
 
@@ -148,6 +149,7 @@ def run_training_loop(
     step_stats_every: int = 0,
     run_meta: Optional[dict] = None,
     pipeline=None,
+    observability=None,
     log=print,
 ):
     """Run the full training loop; returns ``(state, history)`` where history
@@ -190,7 +192,21 @@ def run_training_loop(
     queue, host loader workers, and the synchronous A/B mode. Bitwise
     identical to the synchronous path at every depth; ``step_stats`` windows
     gain the occupancy fields (host_stall_ms, staging/in-flight depth).
+
+    Live telemetry plane (``observability``, the ``observability`` block —
+    ISSUE 10): an opt-in background /metrics exporter fed by the same
+    recorder state the history flushes, per-host telemetry shards through
+    the heartbeat channel with a main-process pod aggregator + straggler
+    detector, and a crash flight recorder dumped on abnormal exits. All
+    host-side: the compiled step, the fence cadence, and the HLO are
+    untouched with the whole plane on.
     """
+    from tpuddp import config as cfg_lib
+    from tpuddp.observability import aggregate as agg_lib
+    from tpuddp.observability import exporter as exp_lib
+    from tpuddp.observability import flight as flight_lib
+    from tpuddp.resilience import watchdog as wd_lib
+
     is_main = jax.process_index() == 0
     pipeline = pipeline_lib.resolve_pipeline(pipeline)
     pbytes = _param_bytes(state.params) if hasattr(state, "params") else None
@@ -247,7 +263,16 @@ def run_training_loop(
             log("Auto-resume requested but no save_dir configured; starting fresh.")
 
     history = []
-    metrics_writer = MetricsWriter(save_dir)
+    # ---- live telemetry plane (observability/{exporter,aggregate,flight}):
+    # the flight ring tees every history record (every process keeps one);
+    # the exporter/aggregator start below once the telemetry bundle exists.
+    obs_cfg = cfg_lib.resolve_observability(observability)
+    flight = None
+    if obs_cfg["flight_recorder"] and save_dir is not None:
+        flight = flight_lib.install(flight_lib.FlightRecorder(
+            save_dir, capacity=int(obs_cfg["flight_capacity"]),
+        ))
+    metrics_writer = MetricsWriter(save_dir, flight=flight)
     # gradient-comm wire-bytes accounting (parallel/comm.py counter): one
     # optimizer update per accumulation cycle; the payload per update is
     # static, so the counter is free host arithmetic next to the device step
@@ -296,12 +321,28 @@ def run_training_loop(
         # the header states the elastic provenance: this run CONTINUES a
         # trajectory that was training on a different world size
         meta_extra["resumed_from_world"] = topo_change.get("from_world")
+    # exporter starts BEFORE the header so the header can record the BOUND
+    # port (ephemeral binds resolve at start); sources attach once the
+    # telemetry bundle exists below
+    exporter = exp_lib.exporter_from_config(obs_cfg, run_dir=save_dir)
+    if exporter is not None:
+        exporter.start()
+    obs_meta = {
+        "exporter": exporter.describe() if exporter is not None else False,
+        "aggregate": bool(obs_cfg["aggregate"]),
+        "straggler_ratio": float(obs_cfg["straggler_ratio"]),
+        "straggler_windows": int(obs_cfg["straggler_windows"]),
+        "flight_recorder": (
+            flight.describe() if flight is not None else False
+        ),
+    }
     metrics_writer.write(make_run_meta(
         mesh=getattr(ddp, "mesh", None),
         world_size=getattr(ddp, "world_size", None),
         comm_hook=getattr(ddp, "comm_hook", None),
         comm_topology=getattr(ddp, "comm_topology", "flat"),
         guard=guard_cfg,
+        observability=obs_meta,
         extra=meta_extra,
     ))
     for ev in reshard_log:
@@ -334,6 +375,29 @@ def run_training_loop(
         device_kind=(
             ddp_mesh.devices.flat[0].device_kind if ddp_mesh is not None else None
         ),
+    )
+    # cross-host aggregation: every process publishes its shard through the
+    # heartbeat channel; process 0 merges + detects stragglers. Inert on
+    # single-process runs (there is no pod to aggregate).
+    aggregator = None
+    shard_dir = None
+    if obs_cfg["aggregate"] and jax.process_count() > 1:
+        shard_dir = wd_lib.heartbeat_dir(save_dir)
+        if shard_dir is not None:
+            os.makedirs(shard_dir, exist_ok=True)
+            if is_main:
+                aggregator = agg_lib.PodAggregator(
+                    shard_dir,
+                    jax.process_count(),
+                    writer=metrics_writer,
+                    straggler_ratio=float(obs_cfg["straggler_ratio"]),
+                    straggler_windows=int(obs_cfg["straggler_windows"]),
+                )
+    tel.attach_live(
+        exporter=exporter,
+        aggregator=aggregator,
+        shard_dir=shard_dir,
+        process_id=jax.process_index(),
     )
 
     prev_total_skips = (
@@ -436,6 +500,15 @@ def run_training_loop(
             "step": tel.recorder.global_step,
         }))
         metrics_writer.sync()
+        # the exit-75 flight recording: the writer tee above means the
+        # preempt event (and the last windows before it) are in the ring
+        if flight is not None:
+            flight.note(
+                emergency_checkpoint=path,
+                emergency_epoch=epoch,
+                emergency_step=tel.recorder.global_step,
+            )
+            flight.dump("preempt")
         raise TrainingPreempted(epoch, path)
 
     if is_main:
@@ -608,6 +681,17 @@ def run_training_loop(
                 record["skipped_steps"] = total_skips
                 record["skipped_steps_epoch"] = epoch_skips
 
+            # live-plane gauges the recorder cannot see: last epoch losses,
+            # guard skips, cumulative comm bytes (host dict updates only)
+            tel.update_live(
+                train_loss=train_loss,
+                test_loss=test_loss,
+                test_accuracy=test_accuracy,
+                skipped_steps=record.get("skipped_steps", 0),
+                grad_comm_bytes_total=comm_counter.total_bytes,
+            )
+            if aggregator is not None:
+                aggregator.update()  # epoch-boundary merge (windows may be off)
             record = stamp("epoch", record)
             history.append(record)
             metrics_writer.write(record)  # post-mortem row always lands
@@ -674,13 +758,28 @@ def run_training_loop(
                     world_size=getattr(ddp, "world_size", None),
                 )
             epoch += 1
+    except TrainingPreempted:
+        raise  # emergency_stop already dumped the "preempt" recording
+    except guard_lib.ReplicaDesync:
+        if flight is not None:
+            flight.dump("desync")
+        raise
+    except BaseException:
+        if flight is not None:
+            flight.dump("exception")
+        raise
     finally:
         # An exception mid-epoch (preemption, NaN guard, a worker crash) must
         # not lose the trace — it is the post-mortem artifact — nor leave the
-        # JSONL metrics record unflushed/truncated.
+        # JSONL metrics record unflushed/truncated. The live plane tears
+        # down too: endpoint closed, flight ring deregistered.
         tel.finish()
         stop_profiler()
         metrics_writer.close()
+        if exporter is not None:
+            exporter.stop()
+        if flight is not None:
+            flight_lib.uninstall(flight)
 
     if is_main:
         log(f"Finished Training on process {jax.process_index()}.")

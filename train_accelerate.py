@@ -228,12 +228,15 @@ def run_training_loop(
     step_stats_every=0,
     run_meta=None,
     pipeline=None,
+    observability=None,
 ):
     # Observability parity with the native epoch driver (training/loop.py):
     # the typed run_meta header opens history.jsonl, epoch rows carry the
     # step recorder's percentile/MFU fields, $TPUDDP_PROFILE traces the
     # first epoch ($TPUDDP_PROFILE_STEPS a step window, SIGUSR1 the next
     # epoch on demand), and $TPUDDP_DEBUG_NANS guards the aggregated losses.
+    # The live plane (ISSUE 10) rides too: opt-in /metrics exporter, pod
+    # shard publishing + aggregation, crash flight recorder.
     from tpuddp.observability import (
         MetricsWriter,
         RunTelemetry,
@@ -243,12 +246,22 @@ def run_training_loop(
         stamp,
         stop_profiler,
     )
+    from tpuddp.observability import aggregate as agg_lib
+    from tpuddp.observability import exporter as exp_lib
+    from tpuddp.observability import flight as flight_lib
     from tpuddp.resilience import faults
     from tpuddp.resilience import guard as guard_lib
+    from tpuddp.resilience import watchdog as wd_lib
 
     from tpuddp.training.pipeline import resolve_pipeline
 
-    metrics_writer = MetricsWriter(save_dir)
+    obs_cfg = cfg_lib.resolve_observability(observability)
+    flight = None
+    if obs_cfg["flight_recorder"] and save_dir is not None:
+        flight = flight_lib.install(flight_lib.FlightRecorder(
+            save_dir, capacity=int(obs_cfg["flight_capacity"]),
+        ))
+    metrics_writer = MetricsWriter(save_dir, flight=flight)
     profiling = maybe_start_profiler(save_dir)
     guard_cfg = guard_lib.resolve_guard(getattr(accelerator, "guard", None))
     pipeline = resolve_pipeline(pipeline)
@@ -286,11 +299,25 @@ def run_training_loop(
     )
     if topo_change is not None:
         meta_extra["resumed_from_world"] = topo_change.get("from_world")
+    # exporter starts BEFORE the header so the header records the bound port
+    exporter = exp_lib.exporter_from_config(obs_cfg, run_dir=save_dir)
+    if exporter is not None:
+        exporter.start()
+    obs_meta = {
+        "exporter": exporter.describe() if exporter is not None else False,
+        "aggregate": bool(obs_cfg["aggregate"]),
+        "straggler_ratio": float(obs_cfg["straggler_ratio"]),
+        "straggler_windows": int(obs_cfg["straggler_windows"]),
+        "flight_recorder": (
+            flight.describe() if flight is not None else False
+        ),
+    }
     metrics_writer.write(make_run_meta(
         mesh=getattr(accelerator, "mesh", None),
         comm_hook=getattr(accelerator, "comm_hook", None),
         comm_topology=getattr(accelerator, "comm_topology", "flat"),
         guard=guard_cfg,
+        observability=obs_meta,
         extra=meta_extra,
     ))
     for ev in restore_events:
@@ -307,6 +334,28 @@ def run_training_loop(
         device_kind=(
             acc_mesh.devices.flat[0].device_kind if acc_mesh is not None else None
         ),
+    )
+    # live plane: shard publishing + main-process aggregation (multi-host
+    # only), exporter sources (native-driver parity)
+    aggregator = None
+    shard_dir = None
+    if obs_cfg["aggregate"] and jax.process_count() > 1:
+        shard_dir = wd_lib.heartbeat_dir(save_dir)
+        if shard_dir is not None:
+            os.makedirs(shard_dir, exist_ok=True)
+            if accelerator.is_local_main_process:
+                aggregator = agg_lib.PodAggregator(
+                    shard_dir,
+                    jax.process_count(),
+                    writer=metrics_writer,
+                    straggler_ratio=float(obs_cfg["straggler_ratio"]),
+                    straggler_windows=int(obs_cfg["straggler_windows"]),
+                )
+    tel.attach_live(
+        exporter=exporter,
+        aggregator=aggregator,
+        shard_dir=shard_dir,
+        process_id=jax.process_index(),
     )
     prev_skips = optimizer.skip_counters()[0] if guard_cfg.enabled else 0
     rollback_count = {"n": 0}
@@ -364,6 +413,13 @@ def run_training_loop(
             "step": tel.recorder.global_step,
         }))
         metrics_writer.sync()
+        # the exit-75 flight recording (the preempt event rode the tee above)
+        if flight is not None:
+            flight.note(
+                emergency_epoch=last_completed_epoch,
+                emergency_step=tel.recorder.global_step,
+            )
+            flight.dump("preempt")
         raise TrainingPreempted(last_completed_epoch + 1)
 
     try:
@@ -466,6 +522,16 @@ def run_training_loop(
                         f"(total {total_skips})."
                     )
 
+            # live-plane gauges (native-driver parity): last epoch losses +
+            # guard skip totals reach /metrics and the published shard
+            tel.update_live(
+                train_loss=train_loss,
+                test_loss=test_loss,
+                test_accuracy=test_accuracy,
+                skipped_steps=guard_fields.get("skipped_steps", 0),
+            )
+            if aggregator is not None:
+                aggregator.update()
             # native-driver record schema (training/loop.py), written BEFORE
             # the NaN guard so a blown-up epoch still leaves its post-mortem
             # row in history.jsonl (non-finite values land as strict-JSON
@@ -524,19 +590,35 @@ def run_training_loop(
                 accelerator.save_model(model, save_dir)
                 accelerator.save_state(model, optimizer, save_dir, epoch=epoch)
             epoch += 1
+    except TrainingPreempted:
+        raise  # drain() already dumped the "preempt" recording
+    except ReplicaDesync:
+        if flight is not None:
+            flight.dump("desync")
+        raise
+    except BaseException:
+        if flight is not None:
+            flight.dump("exception")
+        raise
     finally:
         # an exception mid-epoch must still flush any active trace (it is
         # the post-mortem artifact) and never leave the JSONL history
-        # unflushed/truncated
+        # unflushed/truncated; the live plane tears down with it
         tel.finish()
         if profiling:
             stop_profiler()
         metrics_writer.close()
+        if exporter is not None:
+            exporter.stop()
+        if flight is not None:
+            flight_lib.uninstall(flight)
 
     print("Finished Training.")
 
 
-def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
+def basic_accelerate_training(
+    out_dir: str, training=None, num_chips=None, observability=None
+):
     training = training or cfg_lib.TRAINING_DEFAULTS
     # SIGTERM/SIGINT -> drain flag (polled at managed-loop boundaries);
     # main-thread only, a no-op under threaded test runners
@@ -678,6 +760,7 @@ def basic_accelerate_training(out_dir: str, training=None, num_chips=None):
         start_epoch=start_epoch,
         step_stats_every=int(training.get("step_stats_every") or 0),
         pipeline=pipeline_cfg,
+        observability=observability,
         # run provenance for the history header: which configuration was this?
         run_meta={
             "config_hash": config_hash(training),
@@ -733,7 +816,10 @@ if __name__ == "__main__":
         maybe_reexec_for_world(world_size, cfg_lib.device_from(settings))
 
     try:
-        basic_accelerate_training(out_dir, training, num_chips=world_size)
+        basic_accelerate_training(
+            out_dir, training, num_chips=world_size,
+            observability=cfg_lib.observability_config(settings),
+        )
     except TrainingPreempted as e:
         # the exit-code contract (README "Fault tolerance"): 75 = EX_TEMPFAIL,
         # drained after SIGTERM — requeue the same command to auto-resume

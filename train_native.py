@@ -43,7 +43,9 @@ from tpuddp.training.loop import run_training_loop
 logging.basicConfig(level=logging.INFO, format="%(message)s")
 
 
-def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=None):
+def basic_ddp_training_loop(
+    rank, world_size, save_dir, optional_args, training=None, observability=None
+):
     """Per-process worker — parity with the reference's
     ``basic_DDP_training_loop`` (multi-GPU-training-torch.py:228-266). The
     process group is already up (run_ddp_training called setup)."""
@@ -191,6 +193,9 @@ def basic_ddp_training_loop(rank, world_size, save_dir, optional_args, training=
         # run provenance for the history.jsonl run_meta header
         step_stats_every=int(training.get("step_stats_every") or 0),
         pipeline=pipeline,
+        # live telemetry plane (observability block): opt-in /metrics
+        # exporter, pod aggregation + straggler detection, flight recorder
+        observability=observability,
         run_meta={
             "config_hash": obs.config_hash(training),
             "model": training.get("model"),
@@ -223,7 +228,11 @@ if __name__ == "__main__":
     rendezvous = cfg_lib.rendezvous_from(settings)
 
     run_ddp_training(
-        partial(basic_ddp_training_loop, training=training),
+        partial(
+            basic_ddp_training_loop,
+            training=training,
+            observability=cfg_lib.observability_config(settings),
+        ),
         world_size,
         out_dir,
         optional_args,
