@@ -1,0 +1,65 @@
+"""Wedged-drain worker for the hang-then-escalate chaos legs (ISSUE 11).
+
+Two wedge modes, both simulating a child that cannot complete a clean
+SIGTERM drain:
+
+``wedge-drain`` (default)
+    Installs the real preemption handler, seeds the flight rings, then
+    spins WITHOUT ever polling ``preemption_requested()`` — the drain can
+    never reach a batch-group boundary (a collective that never completes).
+    The preemption failsafe must force exit 75 after
+    ``$TPUDDP_PREEMPT_GRACE`` seconds and dump
+    ``flightrec_preempt_forced.json`` on the way out. On a SECOND attempt
+    (the restart supervisor relaunching it) the marker file is present and
+    the worker exits 0 — so a supervisor run proves the recording is
+    summarized BEFORE the restart decision.
+
+``ignore-sigterm``
+    Sets SIGTERM to SIG_IGN and spins — a child wedged below Python (no
+    failsafe can run). Only SIGKILL ends it: the drain-escalation contract
+    (``fleet.controller.escalate_drain``) must deliver that, and only
+    after the grace window.
+
+Usage: python _chaos_wedge_worker.py <out_dir> [wedge-drain|ignore-sigterm]
+"""
+
+import os
+import signal
+import sys
+import time
+
+out_dir = sys.argv[1]
+mode = sys.argv[2] if len(sys.argv) > 2 else "wedge-drain"
+os.makedirs(out_dir, exist_ok=True)
+
+marker = os.path.join(out_dir, "wedge_attempt.marker")
+if os.path.exists(marker):
+    print("WEDGE second attempt: clean exit", flush=True)
+    sys.exit(0)
+with open(marker, "w") as f:
+    f.write("1\n")
+
+if mode == "ignore-sigterm":
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    print("WEDGE armed (SIGTERM ignored)", flush=True)
+    while True:
+        time.sleep(0.05)
+
+# wedge-drain: the real handler + flight rings, then a drain that can
+# never finish
+from tpuddp.observability import flight, schema  # noqa: E402
+from tpuddp.resilience import preemption  # noqa: E402
+
+recorder = flight.FlightRecorder(out_dir, process_index=0)
+flight.install(recorder)
+recorder.observe(schema.stamp("event", {"event": "wedge_armed", "epoch": 0}))
+recorder.note(wedge_mode=mode, pid=os.getpid())
+preemption.install_preemption_handler()
+print("WEDGE armed (drain will wedge)", flush=True)
+# self-delivered SIGTERM: the drain starts NOW, and can never finish —
+# the failsafe must force exit 75 after $TPUDDP_PREEMPT_GRACE
+os.kill(os.getpid(), signal.SIGTERM)
+while True:
+    # never polls preemption_requested(): the drain wedges; only the
+    # failsafe's forced exit 75 (flight dump included) can end this loop
+    time.sleep(0.05)

@@ -541,6 +541,101 @@ def test_supervisor_end_to_end_preempt_then_resume(tmp_path):
     validate_history(tmp_path)
 
 
+def test_wedged_drain_forced_exit_summarized_before_restart(tmp_path):
+    """Hang-then-escalate leg, failsafe half (ISSUE 11 satellite): a child
+    whose SIGTERM drain WEDGES (never reaches a batch-group boundary) must
+    be force-exited 75 by the failsafe only after $TPUDDP_PREEMPT_GRACE,
+    dumping flightrec_preempt_forced.json on the way out — and the restart
+    supervisor must summarize that recording BEFORE its restart decision."""
+    wedge = os.path.join(REPO, "tests", "_chaos_wedge_worker.py")
+    proc = subprocess.run(
+        [
+            sys.executable, "-u", SUPERVISE,
+            "--max-restarts", "2", "--backoff-base", "0.1",
+            "--flight-dir", str(tmp_path),
+            "--",
+            sys.executable, "-u", wedge, str(tmp_path), "wedge-drain",
+        ],
+        env=chaos_env(TPUDDP_PREEMPT_GRACE=3),
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    both = proc.stdout + proc.stderr
+    assert proc.returncode == 0, both[-3000:]
+    # the drain wedged and the FAILSAFE ended it — not a clean drain, and
+    # not a SIGKILL: the grace window was honored, then exit 75
+    assert "exceeded the 3s grace window" in both
+    flightrec = os.path.join(str(tmp_path), "flightrec_preempt_forced.json")
+    assert os.path.exists(flightrec)
+    validate = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "tools", "tpuddp_inspect.py"),
+            "--validate", flightrec,
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert validate.returncode == 0, validate.stdout + validate.stderr
+    # ordering: the supervisor read the post-mortem BEFORE deciding to
+    # resume — the summary line precedes the restart line in its log
+    summary_at = both.find("reason=preempt_forced")
+    resume_at = both.find("resuming immediately")
+    assert 0 <= summary_at < resume_at, both[-3000:]
+    # the recording carried the worker's seeded ring + notes
+    with open(flightrec) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "preempt_forced"
+    assert payload["notes"]["wedge_mode"] == "wedge-drain"
+    assert any(
+        e.get("event") == "wedge_armed" for e in payload["records"]["event"]
+    )
+
+
+def test_fleet_chaos_multi_job_pool(tmp_path):
+    """ISSUE 11 acceptance: the scripted fleet chaos demo — >= 3 jobs
+    (2 training + 1 serving + a late high-priority arrival) share one pool;
+    one training job is SIGKILLed mid-run and resumes, the high-priority
+    arrival shrinks a neighbor through the drain contract, the serving job
+    autoscales replicas on a p99 SLO breach — then every job's namespaced
+    history must validate with correct resumed_from_world attribution."""
+    proc = subprocess.run(
+        [
+            sys.executable, "-u", os.path.join(REPO, "tools", "fleet.py"),
+            "chaos-demo", "--out", str(tmp_path), "--timeout", "780",
+        ],
+        env=chaos_env(), cwd=REPO, capture_output=True, text=True, timeout=840,
+    )
+    assert proc.returncode == 0, (
+        proc.stdout[-4000:] + "\n---\n" + proc.stderr[-4000:]
+    )
+    assert "fleet chaos: PASS" in proc.stdout
+    jobs_dir = os.path.join(str(tmp_path), "jobs")
+    names = sorted(os.listdir(jobs_dir))
+    assert names == ["serve-c", "train-a", "train-b", "train-d"]
+    # independent re-verification over the artifacts the demo left behind
+    for name in names:
+        validate_history(os.path.join(jobs_dir, name))
+    a_records = [
+        r for r in history_records(os.path.join(jobs_dir, "train-a"))
+    ]
+    topo = [r for r in a_records if r.get("event") == "topology_change"]
+    assert any(t["from_world"] == 2 and t["to_world"] == 1 for t in topo)
+    assert any(
+        r.get("type") == "run_meta" and r.get("resumed_from_world") == 2
+        for r in a_records
+    )
+    c_metas = [
+        r for r in history_records(os.path.join(jobs_dir, "serve-c"))
+        if r.get("type") == "run_meta"
+    ]
+    assert [m.get("num_replicas") for m in c_metas][0] == 1
+    assert any(m.get("num_replicas") == 2 for m in c_metas)
+    # namespacing: every training job kept its own checkpoint channel
+    # under its own dir (per-job exporter ports are proven distinct by the
+    # demo itself, mid-run, via read_live_port against each run dir)
+    for name in ("train-a", "train-b", "train-d"):
+        run_dir = os.path.join(jobs_dir, name)
+        assert any(f.startswith("ckpt_") for f in os.listdir(run_dir))
+
+
 def test_hang_at_barrier_detected_by_watchdog(tmp_path):
     """A peer that stops making progress (hang@barrier — indistinguishable
     from a preempted host) must be detected by the survivor's watchdog within

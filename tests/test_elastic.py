@@ -477,6 +477,47 @@ def test_supervisor_backoff_grows_and_is_jittered():
         assert lo <= d <= hi
 
 
+def test_supervisor_signal_death_is_backoff_restartable(caplog):
+    """Policy-matrix rows rc=-9/-15 (ISSUE 11 satellite): a child killed by
+    a signal (subprocess reports -N) restarts with backoff + auto-resume,
+    the log line NAMES the signal, and the death never extends the
+    peer-death (76) shrink streak."""
+    import logging
+
+    sup, runner, sleeps = make_supervisor(
+        [-9, -15, 0],
+        world_size=8,
+        policy=SupervisorPolicy(
+            backoff_base=0.01, backoff_cap=0.02, shrink_after=1
+        ),
+    )
+    with caplog.at_level(logging.WARNING, logger="tpuddp"):
+        assert sup.run() == 0
+    assert len(sleeps) == 2  # both signal deaths backed off
+    assert [h[1] for h in sup.history] == [-9, -15, 0]
+    # never shrank: signal deaths are crashes, not peer-death evidence
+    # (shrink_after=1 would have shrunk on the FIRST exit-76)
+    assert all(h[2] == 8 for h in sup.history)
+    assert runner.calls[1][1]["TPUDDP_AUTO_RESUME"] == "1"
+    text = caplog.text
+    assert "killed by SIGKILL" in text
+    assert "killed by SIGTERM" in text
+
+
+def test_supervisor_signal_death_resets_peer_death_streak():
+    """A 76 followed by an OOM SIGKILL followed by another 76 is NOT two
+    consecutive peer deaths — the streak restarts at the signal death."""
+    sup, runner, sleeps = make_supervisor(
+        [EXIT_WATCHDOG, -9, EXIT_WATCHDOG, 0],
+        world_size=8,
+        policy=SupervisorPolicy(
+            backoff_base=0.01, backoff_cap=0.02, shrink_after=2
+        ),
+    )
+    assert sup.run() == 0
+    assert all(h[2] == 8 for h in sup.history)  # the streak never hit 2
+
+
 def test_supervise_cli_parses_and_runs(tmp_path):
     """tools/supervise.py end-to-end over a trivial child command."""
     import subprocess

@@ -689,7 +689,7 @@ def test_exporter_ephemeral_bind_and_endpoints(tmp_path):
     try:
         assert a.port and b.port and a.port != b.port
         port_file = tmp_path / PORT_FILENAME
-        assert int(port_file.read_text()) == a.port
+        assert int(port_file.read_text().splitlines()[0]) == a.port
         a.register_source("t", lambda: {"x_total": counter(3, "x")})
         assert _prom_value(_scrape(a.port), "tpuddp_x_total") == 3
         health = json.loads(_scrape(a.port, "/healthz"))
@@ -1422,7 +1422,7 @@ def test_exporter_port_file_per_process_name(tmp_path, monkeypatch):
     assert e.port_filename == "exporter_p2.port"
     e.start()
     try:
-        assert int((tmp_path / "exporter_p2.port").read_text()) == e.port
+        assert int((tmp_path / "exporter_p2.port").read_text().splitlines()[0]) == e.port
         assert not (tmp_path / "exporter.port").exists()
     finally:
         e.stop()

@@ -197,9 +197,18 @@ def main(argv=None) -> int:
 
     artifacts, candidate = load_artifacts(args.fresh, repo=args.repo)
     if not artifacts:
+        # a fresh clone (no committed BENCH_r*.json yet) has no trajectory
+        # to regress against — that is an empty gate, not a failure
         print("bench_trend: no BENCH_r*.json artifacts with config rows "
-              "found", file=sys.stderr)
-        return 2
+              "found — nothing to compare, nothing to regress (exit 0)")
+        return 0
+    if candidate is None:
+        # --fresh pointed at an artifact with no config rows: report the
+        # committed trajectory, but there is no candidate to judge
+        print_trajectory(artifacts)
+        print(f"bench_trend: --fresh {args.fresh} carries no config rows — "
+              "no candidate to judge (exit 0)")
+        return 0
     print_trajectory(artifacts)
     regressions = check_regressions(artifacts, candidate, args.threshold)
     if regressions:

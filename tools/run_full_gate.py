@@ -55,6 +55,17 @@ The merged history.jsonl must validate and carry a topology_change event
 row; elastic restore drifting (a reshard that crashes, or stops recording
 its provenance) fails the gate here.
 
+Fleet gate (after the elastic gate): ``tools/fleet.py chaos-demo`` shares
+one CPU-mesh pool between 2 training jobs and 1 serving job under the
+fleet controller (ISSUE 11): one training job is SIGKILLed mid-run and
+resumes elastically, a late high-priority arrival preempts capacity
+through the drain contract (exit 75 -> shrunk $TPUDDP_WORLD_SIZE resume,
+never SIGKILL-first), and the serving job autoscales its replicas on a
+p99 SLO breach ($TPUDDP_SERVING_REPLICAS). Every job's namespaced
+history.jsonl is then independently re-validated with tpuddp_inspect —
+a controller that lets co-scheduled jobs corrupt each other's channels
+fails here.
+
 Observability gate (last): tools/bench_trend.py across the committed
 BENCH_r*.json artifacts (a >10% regression of any same-device best row
 fails), a live exporter scrape (a serving engine with the
@@ -431,6 +442,48 @@ def _pipeline_gate(env) -> int:
     return 0
 
 
+def _fleet_gate(env) -> int:
+    """Fleet-control-plane leg (ISSUE 11): the scripted multi-job chaos
+    demo (2 training + 1 serving + 1 late high-priority arrival on one
+    pool: kill one, preempt one, autoscale one) must pass its own checks,
+    and every job's namespaced history must ALSO validate when this gate
+    re-runs tpuddp_inspect over it independently."""
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    with tempfile.TemporaryDirectory(prefix="tpuddp_fleet_gate_") as out_dir:
+        gate_env = dict(env)
+        gate_env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        rc = subprocess.call(
+            [
+                sys.executable, "-u",
+                os.path.join(REPO, "tools", "fleet.py"),
+                "chaos-demo", "--out", out_dir,
+            ],
+            cwd=REPO, env=gate_env,
+        )
+        if rc != 0:
+            print(f"fleet gate: chaos demo exited {rc}", file=sys.stderr)
+            return rc
+        jobs_dir = os.path.join(out_dir, "jobs")
+        job_names = sorted(os.listdir(jobs_dir))
+        if len(job_names) < 4:
+            print(f"fleet gate: expected >= 4 namespaced job dirs, found "
+                  f"{job_names}", file=sys.stderr)
+            return 1
+        for name in job_names:
+            history = os.path.join(jobs_dir, name, "history.jsonl")
+            rc = subprocess.call(
+                [sys.executable, inspect, "--validate", history],
+                cwd=REPO, env=env,
+            )
+            if rc != 0:
+                print(f"fleet gate: {name}/history.jsonl failed validation",
+                      file=sys.stderr)
+                return rc
+        print("fleet gate: kill + preempt + autoscale survived with every "
+              "namespaced history valid")
+    return 0
+
+
 def _observability_gate(env) -> int:
     """Live-telemetry leg (ISSUE 10): (a) tools/bench_trend.py across the
     committed BENCH_r*.json artifacts — a >10% regression of any best
@@ -490,7 +543,8 @@ def _observability_gate(env) -> int:
             port = None
             while time.time() < deadline:
                 if os.path.exists(port_file):
-                    port = int(open(port_file).read().strip())
+                    # line 1 is the port; line 2 the bound host
+                    port = int(open(port_file).read().splitlines()[0])
                     break
                 if proc.poll() is not None:
                     print("observability gate: serving process died before "
@@ -635,6 +689,9 @@ def main(argv=None):
     if rc != 0:
         return rc
     rc = _elastic_gate(env)
+    if rc != 0:
+        return rc
+    rc = _fleet_gate(env)
     if rc != 0:
         return rc
     return _observability_gate(env)

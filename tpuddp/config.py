@@ -238,10 +238,18 @@ def _merge_refusing_unknown(defaults, overrides, block: str):
 def serving_config(settings: Dict[str, Any]) -> Dict[str, Any]:
     """Merge the settings file's ``serving`` block over
     :data:`SERVING_DEFAULTS`, refusing unknown keys (the ``training.guard``
-    contract)."""
-    return _merge_refusing_unknown(
+    contract). ``$TPUDDP_SERVING_REPLICAS`` overrides ``num_replicas`` the
+    way ``$TPUDDP_WORLD_SIZE`` overrides the training world
+    (:func:`world_size_from`): the fleet controller resizes a serving job
+    by draining it (exit 75) and relaunching the same command with this
+    set — one elastic contract for both job kinds."""
+    cfg = _merge_refusing_unknown(
         SERVING_DEFAULTS, settings.get("serving") or {}, "serving"
     )
+    env = os.environ.get("TPUDDP_SERVING_REPLICAS")
+    if env:
+        cfg["num_replicas"] = int(env)
+    return cfg
 
 
 # Label-space size by dataset name; the reference hardcodes 10 because its only

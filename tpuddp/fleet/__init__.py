@@ -1,0 +1,25 @@
+"""Fleet control plane — gang-schedule many jobs over one device pool.
+
+`tools/supervise.py` babysits ONE process tree; this package promotes that
+into a controller for a whole pool (ROADMAP item 5 / ISSUE 11):
+
+- :mod:`tpuddp.fleet.spec`       — declarative job specs + admission rules;
+- :mod:`tpuddp.fleet.scheduler`  — the pure, deterministic gang-placement /
+  priority-preemption / rebalance planner (no processes, unit-testable);
+- :mod:`tpuddp.fleet.controller` — the live controller: per-job
+  ``RestartSupervisor`` under a namespaced run dir, drain-first preemption
+  with grace-window SIGKILL escalation, elastic resizes through the exit-75
+  -> ``$TPUDDP_WORLD_SIZE`` resume contract;
+- :mod:`tpuddp.fleet.autoscale`  — the metric-driven autoscaler: scrapes
+  each job's live ``/metrics`` endpoint (port discovered via the namespaced
+  ``exporter.port`` file, liveness-verified through ``/healthz``) and moves
+  the planner's per-job desired worlds with hysteresis + cooldown.
+
+``tools/fleet.py`` is the CLI; the chaos proof lives in its ``chaos-demo``
+subcommand and ``tests/test_chaos.py``.
+"""
+
+from tpuddp.fleet.autoscale import Autoscaler, AutoscalePolicy  # noqa: F401
+from tpuddp.fleet.controller import FleetController  # noqa: F401
+from tpuddp.fleet.scheduler import JobView, Plan, plan_fleet  # noqa: F401
+from tpuddp.fleet.spec import FleetAdmissionError, JobSpec  # noqa: F401

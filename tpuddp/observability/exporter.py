@@ -199,6 +199,17 @@ class MetricsExporter:
     def start(self) -> "MetricsExporter":
         if self._server is not None:
             return self
+        # a SIGKILLed predecessor never ran its stop(): its port file is
+        # still on disk, pointing at a port nobody owns (or, worse, one the
+        # OS re-issued to a stranger). Remove it BEFORE binding so a reader
+        # polling during our startup sees "no port yet", never a stale one
+        # — and readers must treat any port as live only after a /healthz
+        # probe succeeds (:func:`read_live_port`) regardless.
+        if self.run_dir is not None:
+            try:
+                os.remove(os.path.join(self.run_dir, self.port_filename))
+            except OSError:
+                pass
         exporter = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -260,7 +271,11 @@ class MetricsExporter:
 
     def _write_port_file(self) -> None:
         """Publish the bound port next to the run artifacts (atomic write) —
-        how operators and the gate's scrape leg discover an ephemeral port."""
+        how operators and the gate's scrape leg discover an ephemeral port.
+        Line 1 is the port; line 2 the bound host (the heartbeat-file shape:
+        readers that only care about the port parse line 1 ONLY, and
+        :func:`read_live_port` probes the recorded host so a
+        non-loopback-bound exporter is discoverable too)."""
         if self.run_dir is None or self.port is None:
             return
         try:
@@ -268,7 +283,7 @@ class MetricsExporter:
             path = os.path.join(self.run_dir, self.port_filename)
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
-                f.write(f"{self.port}\n")
+                f.write(f"{self.port}\n{self.host}\n")
             os.replace(tmp, path)
         except OSError as e:  # best-effort discovery aid, never fatal
             logger.warning("exporter: port file write failed: %s", e)
@@ -291,6 +306,47 @@ class MetricsExporter:
     def describe(self) -> dict:
         """The run_meta ``observability.exporter`` provenance fields."""
         return {"host": self.host, "port": self.port}
+
+
+def read_live_port(
+    run_dir: str,
+    port_filename: str = PORT_FILENAME,
+    host: Optional[str] = None,
+    probe_timeout: float = 1.0,
+) -> Optional[int]:
+    """The discovery contract for ``<run_dir>/exporter.port`` READERS (the
+    fleet autoscaler, gate scrape legs, operators): a port file is a hint,
+    not a liveness proof — a SIGKILLed run leaves its file behind. Returns
+    the port only after a ``/healthz`` probe (short ``probe_timeout``)
+    answers ``{"status": "ok"}``; None for a missing/garbled file, a dead
+    port, or a non-ok answer. The probe targets the file's line-2 host
+    (what the exporter actually bound — a non-loopback bind is probed where
+    it lives), unless ``host`` overrides it; a single-line legacy file or a
+    bind-all host falls back to loopback."""
+    import json as json_lib
+    import urllib.request
+
+    path = os.path.join(run_dir, port_filename)
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+        port = int(lines[0].strip())
+    except (OSError, ValueError, IndexError):
+        return None
+    if host is None:
+        host = lines[1].strip() if len(lines) > 1 and lines[1].strip() else ""
+        if not host or host in ("0.0.0.0", "::"):
+            host = "127.0.0.1"
+    try:
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/healthz", timeout=probe_timeout
+        ) as resp:
+            health = json_lib.load(resp)
+    except Exception:  # noqa: BLE001 — dead/foreign port == not live
+        return None
+    if isinstance(health, dict) and health.get("status") == "ok":
+        return port
+    return None
 
 
 def exporter_from_config(obs_cfg: dict, run_dir=None) -> Optional[MetricsExporter]:

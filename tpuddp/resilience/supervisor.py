@@ -16,6 +16,11 @@ exits with, and restarts it under the right policy —
   exponential backoff** (the resilience/retry.py discipline: decorrelate N
   supervisors stampeding a shared rendezvous) and auto-resume from the
   newest intact checkpoint.
+- **negative codes** — the child was killed by a signal (subprocess reports
+  signal N as ``-N``: an OOM SIGKILL, a node reclaim, the fleet
+  controller's drain escalation). Classified as backoff-restartable with
+  the signal NAMED in the log line (:func:`classify_exit`) — never as a
+  peer-death streak, so a SIGKILLed child cannot shrink the world.
 - repeated ``76`` (peer death keeps recurring — the pod genuinely lost
   capacity, it is not a transart): **degrade gracefully** instead of dying —
   shrink the world size by ``shrink_factor`` (``$TPUDDP_WORLD_SIZE``, which
@@ -38,7 +43,9 @@ import dataclasses
 import logging
 import os
 import random
+import signal as signal_lib
 import subprocess
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -53,6 +60,26 @@ logger = logging.getLogger("tpuddp")
 WORLD_ENV = "TPUDDP_WORLD_SIZE"
 _AUTO_RESUME_ENV = "TPUDDP_AUTO_RESUME"
 _SPAWNED_ENV = "TPUDDP_SPAWNED"
+
+
+def classify_exit(rc: int) -> str:
+    """Human label for a child exit code, incl. signal deaths: subprocess
+    reports a child killed by signal N as rc == -N (an OOM SIGKILL, a
+    scheduler's hard stop, the fleet controller's drain escalation). A
+    signal death is a crash-shaped restartable failure — never a peer-death
+    (76) streak — and the label names the signal so the log line says
+    'killed by SIGKILL', not 'exited -9'."""
+    if rc < 0:
+        try:
+            name = signal_lib.Signals(-rc).name
+        except ValueError:
+            name = f"signal {-rc}"
+        return f"killed by {name}"
+    return {
+        EXIT_PREEMPTED: "preemption drain",
+        EXIT_WATCHDOG: "stale peer",
+        EXIT_DESYNC: "replica desync",
+    }.get(rc, "crash")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -88,10 +115,6 @@ class SupervisorPolicy:
         return base * rng.uniform(1.0 - self.jitter, 1.0 + self.jitter)
 
 
-def _run_subprocess(argv: Sequence[str], env: Dict[str, str]) -> int:
-    return subprocess.call(list(argv), env=env)
-
-
 class RestartSupervisor:
     """Supervise one training command through the exit-code contract.
 
@@ -112,30 +135,105 @@ class RestartSupervisor:
         sleep: Callable[[float], None] = time.sleep,
         rng: Optional[random.Random] = None,
         flight_dir: Optional[str] = None,
+        world_env_var: str = WORLD_ENV,
     ):
         """``flight_dir``: where the supervised run dumps its crash flight
         recordings (``flightrec_<reason>.json`` — usually the run's
         out_dir). When set, the supervisor summarizes the newest recording
         at startup (a previous run's post-mortem) and after every abnormal
         child exit, BEFORE deciding restart/shrink — the operator sees what
-        the child was doing when it died, not just the exit code."""
+        the child was doing when it died, not just the exit code.
+
+        ``world_env_var``: which env var carries the world size to the
+        child. Training jobs use the default ``$TPUDDP_WORLD_SIZE``;
+        serving jobs under the fleet controller use
+        ``$TPUDDP_SERVING_REPLICAS`` (config.serving_config honors it), so
+        ONE drain -> resume contract resizes both kinds."""
         self.argv = list(argv)
         self.policy = policy or SupervisorPolicy()
         self.world_size = int(world_size) if world_size else None
         self.env = dict(env or {})
         self.first_attempt_env = dict(first_attempt_env or {})
         self.auto_resume_first = bool(auto_resume_first)
-        self.runner = runner or _run_subprocess
+        self.runner = runner or self._popen_runner
         self.sleep = sleep
         self._rng = rng or random.Random()
         self.flight_dir = flight_dir
+        self.world_env_var = world_env_var
         self._summarized: set = set()  # (path, mtime) pairs already logged
         # (attempt_index, exit_code, world_size) per child run — the
         # supervisor's own post-mortem trail (tests assert against it)
         self.history: List[Tuple[int, int, Optional[int]]] = []
+        # the live child (default popen runner only) — the fleet controller
+        # signals it to drain (SIGTERM) or escalate (SIGKILL after grace)
+        self.child: Optional[subprocess.Popen] = None
+        self._current_world: Optional[int] = None
+        self._stop = threading.Event()
+
+    # ---------------------------------------------------------- fleet API --
+    def _popen_runner(self, argv: Sequence[str], env: Dict[str, str]) -> int:
+        """Default runner: like ``subprocess.call`` but keeps the live Popen
+        on ``self.child`` so an external controller can deliver signals.
+        Like ``call``, an exception while waiting (KeyboardInterrupt on the
+        supervising terminal) kills the child before propagating — a
+        supervisor dying must not orphan a trainer that keeps the run dir,
+        heartbeats, and exporter port."""
+        proc = subprocess.Popen(list(argv), env=env)
+        self.child = proc
+        try:
+            return proc.wait()
+        except BaseException:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+            proc.wait()
+            raise
+        finally:
+            self.child = None
+
+    @property
+    def current_world(self) -> Optional[int]:
+        """The world the LIVE (or most recent) child was launched at — what
+        it actually holds on the pool, as opposed to ``world_size`` (the
+        target of the NEXT attempt, which ``set_world`` may have already
+        retargeted mid-drain). The fleet controller gates new starts on the
+        sum of these so a drain window cannot oversubscribe the pool."""
+        return self._current_world
+
+    def set_world(self, world_size: Optional[int]) -> None:
+        """Retarget the NEXT attempt's world (the fleet rebalance lever):
+        the controller sets the new world, then SIGTERMs the live child —
+        its exit-75 drain makes the supervisor relaunch immediately with
+        the updated ``world_env_var``, resuming through the elastic path."""
+        self.world_size = int(world_size) if world_size else None
+
+    def request_stop(self) -> None:
+        """Stop supervising after the CURRENT child exits (no restart).
+        Set this BEFORE signalling the child, or the supervisor may win the
+        race and relaunch a job the fleet controller just preempted."""
+        self._stop.set()
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop.is_set()
+
+    def signal_child(self, sig: int) -> bool:
+        """Deliver ``sig`` to the live child; False when no child is
+        running (e.g. the supervisor is between attempts in backoff)."""
+        child = self.child
+        if child is None or child.poll() is not None:
+            return False
+        try:
+            child.send_signal(sig)
+            return True
+        except (ProcessLookupError, OSError):
+            return False
 
     # ------------------------------------------------------------------ env --
-    def _child_env(self, attempt: int) -> Dict[str, str]:
+    def _child_env(
+        self, attempt: int, world: Optional[int] = None
+    ) -> Dict[str, str]:
         env = dict(os.environ)
         env.update(self.env)
         # the child must be free to re-exec for ITS world size (a shrunk
@@ -151,8 +249,9 @@ class RestartSupervisor:
             for k in self.first_attempt_env:
                 env.pop(k, None)
             env[_AUTO_RESUME_ENV] = "1"
-        if self.world_size:
-            env[WORLD_ENV] = str(self.world_size)
+        world = self.world_size if world is None else world
+        if world:
+            env[self.world_env_var] = str(world)
         return env
 
     # ---------------------------------------------------------- flight --
@@ -189,8 +288,18 @@ class RestartSupervisor:
         # surface it before the first attempt
         self.summarize_flight()
         while True:
-            rc = self.runner(self.argv, self._child_env(attempt))
-            self.history.append((attempt, rc, self.world_size))
+            if self._stop.is_set():
+                # stopped before this attempt launched — incl. a preemption
+                # that lands before the FIRST child ever spawns: preempted
+                # work must not run even once
+                return self.history[-1][1] if self.history else 0
+            # snapshot the launched world BEFORE running: set_world may
+            # retarget world_size mid-drain, and both current_world and the
+            # history row must name what this child actually held
+            launched = self.world_size
+            self._current_world = launched
+            rc = self.runner(self.argv, self._child_env(attempt, launched))
+            self.history.append((attempt, rc, launched))
             attempt += 1
             if rc == 0:
                 logger.info("supervisor: child finished cleanly")
@@ -198,6 +307,15 @@ class RestartSupervisor:
             # the child died abnormally: read its flight recording(s) before
             # deciding how (and at what world size) to restart
             self.summarize_flight()
+            if self._stop.is_set():
+                # the controller preempted/stopped this job: the drain (or
+                # its escalation) ended the child; surface the code, never
+                # relaunch preempted work
+                logger.warning(
+                    "supervisor: stop requested; child exited %d (%s), not "
+                    "restarting", rc, classify_exit(rc),
+                )
+                return rc
             restarts += 1
             if restarts > self.policy.max_restarts:
                 logger.critical(
@@ -243,10 +361,7 @@ class RestartSupervisor:
             logger.warning(
                 "supervisor: child exited %d (%s); restart %d/%d with "
                 "auto-resume in %.1fs",
-                rc,
-                {EXIT_WATCHDOG: "stale peer", EXIT_DESYNC: "replica desync"}.get(
-                    rc, "crash"
-                ),
+                rc, classify_exit(rc),
                 restarts, self.policy.max_restarts, delay,
             )
             self.sleep(delay)
