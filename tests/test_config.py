@@ -222,3 +222,35 @@ def test_serving_config_refuses_unknown_keys():
         cfg.serving_config({"serving": {"max_batch_szie": 16}})
     with pytest.raises(ValueError, match="unknown serving key"):
         cfg.serving_config({"serving": {"zzz_not_a_knob": 1}})
+
+
+def test_decode_config_disarmed_by_default():
+    serving = cfg.serving_config({})
+    assert serving["decode"] is None
+    assert cfg.decode_config(serving) is None
+    assert cfg.decode_config({"decode": False}) is None
+
+
+def test_decode_config_true_and_merge():
+    assert cfg.decode_config({"decode": True}) == cfg.DECODE_DEFAULTS
+    out = cfg.decode_config(
+        {"decode": {"max_slots": 16, "stop_token": 3, "temperature": 0.7}}
+    )
+    assert out["max_slots"] == 16 and out["stop_token"] == 3
+    assert out["temperature"] == 0.7
+    # untouched knobs keep their defaults
+    assert out["kv_block_size"] == cfg.DECODE_DEFAULTS["kv_block_size"]
+    # the serving loader carries the block through intact
+    serving = cfg.serving_config({"serving": {"decode": {"max_slots": 2}}})
+    assert cfg.decode_config(serving)["max_slots"] == 2
+
+
+def test_decode_config_refuses_unknown_keys_and_bad_type():
+    """serving.decode rides the same unknown-key-refusal contract as every
+    other block: a typo'd knob fails loudly with a did-you-mean."""
+    with pytest.raises(ValueError, match="max_slot.*did you mean.*max_slots"):
+        cfg.decode_config({"decode": {"max_slot": 4}})
+    with pytest.raises(ValueError, match="unknown serving.decode key"):
+        cfg.decode_config({"decode": {"zzz_not_a_knob": 1}})
+    with pytest.raises(ValueError, match="mapping or bool"):
+        cfg.decode_config({"decode": 7})

@@ -749,3 +749,48 @@ def test_bench_trend_fresh_without_rows_exits_zero(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "no candidate to judge" in out
+
+
+def test_bench_trend_tracks_tokens_per_sec_rows(tmp_path, capsys):
+    """Decode-flavored rows (tokens_per_sec, ISSUE 12) ride the trajectory
+    and the regression gate instead of being silently dropped — and a row
+    name shared with a request-rate artifact is judged per metric, never
+    across them."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_trend
+    finally:
+        sys.path.pop(0)
+    # a request-granularity serving artifact and two decode artifacts that
+    # REUSE the row name "closed_loop" under the other rate metric
+    (tmp_path / "SERVING_r01.json").write_text(json.dumps({
+        "metric": "rps", "device": "cpu",
+        "configs": {"closed_loop": {"samples_per_sec_per_chip": 5000.0}},
+    }))
+    (tmp_path / "SERVING_r02.json").write_text(json.dumps({
+        "metric": "tps", "device": "cpu",
+        "configs": {"closed_loop": {"tokens_per_sec": 1000.0}},
+    }))
+    rc = bench_trend.main(["--repo", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1,000t/s" in out  # the decode row is IN the trajectory
+    # a decode regression against the decode best is caught...
+    fresh = tmp_path / "bench_results.json"
+    fresh.write_text(json.dumps({
+        "metric": "tps", "device": "cpu",
+        "configs": {"closed_loop": {"tokens_per_sec": 500.0}},
+    }))
+    rc = bench_trend.main(["--repo", str(tmp_path), "--fresh", str(fresh)])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "tokens/s" in err and "closed_loop" in err
+    # ...but 1000 tokens/s is NOT judged against the 5000 samples/s row of
+    # the same name (cross-metric comparison would flag a phantom 80% drop)
+    fresh.write_text(json.dumps({
+        "metric": "tps", "device": "cpu",
+        "configs": {"closed_loop": {"tokens_per_sec": 1000.0}},
+    }))
+    assert bench_trend.main(
+        ["--repo", str(tmp_path), "--fresh", str(fresh)]
+    ) == 0

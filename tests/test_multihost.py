@@ -24,8 +24,91 @@ def free_port():
     return port
 
 
+# Probe script for the multi-process backend env: two 1-device processes
+# rendezvous and run the cheapest cross-process collective the framework
+# uses (broadcast_one_to_all). Some jaxlib builds rendezvous fine but then
+# refuse the computation itself ("Multiprocess computations aren't
+# implemented on the CPU backend") — probing initialize alone would miss
+# exactly the failure mode these tests die of.
+_PROBE = """
+import sys
+import numpy as np
+import jax
+from jax.experimental import multihost_utils
+jax.distributed.initialize(
+    coordinator_address="127.0.0.1:%s", num_processes=2,
+    process_id=int(sys.argv[1]),
+)
+out = multihost_utils.broadcast_one_to_all(np.ones((1,), np.float32))
+assert float(out[0]) == 1.0
+print("MULTIHOST_PROBE_OK")
+"""
+
+_probe_cache = {}
+
+
+def multiprocess_backend_reason():
+    """None when this host can run 2-process CPU-backend collectives; else a
+    typed one-line reason (the skip message) naming what is absent."""
+    if "reason" in _probe_cache:
+        return _probe_cache["reason"]
+    port = free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)  # 1 device per probe process
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _PROBE % port, str(i)],
+            env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    reason = None
+    try:
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                reason = ("multi-process backend env absent: 2-process "
+                          "rendezvous hung")
+                break
+            if p.returncode != 0 or "MULTIHOST_PROBE_OK" not in out:
+                tail = [l for l in out.strip().splitlines() if l][-1:] or ["no output"]
+                reason = (
+                    "multi-process backend env absent: cross-process CPU "
+                    f"collective failed ({tail[0][:160]})"
+                )
+                break
+    finally:
+        # a failed probe leaves its SIBLING blocked in rendezvous on the
+        # dead coordinator: kill + reap every process on every exit path
+        # (no lingering port holder, no zombie)
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+            try:
+                q.communicate(timeout=30)
+            except Exception:  # noqa: BLE001 — best-effort reap
+                pass
+    _probe_cache["reason"] = reason
+    return reason
+
+
+@pytest.fixture(scope="module")
+def multiprocess_backend():
+    """Skip (typed reason), never error, when the multi-process backend env
+    is absent — e.g. a jaxlib whose CPU backend rejects multiprocess
+    computations, or a sandbox without loopback rendezvous."""
+    reason = multiprocess_backend_reason()
+    if reason is not None:
+        pytest.skip(reason)
+
+
 @pytest.mark.slow
-def test_two_process_dp_world(tmp_path):
+def test_two_process_dp_world(tmp_path, multiprocess_backend):
     port = free_port()
     env = dict(os.environ)
     # clean CPU-only children: no TPU plugin, 4 host devices each
@@ -93,7 +176,7 @@ def test_two_process_dp_world(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_host_world_from_cli(tmp_path):
+def test_two_host_world_from_cli(tmp_path, multiprocess_backend):
     """VERDICT r2 #3: the multi-host world must be reachable from the actual
     CLI surface — one shared settings file with a ``local.rendezvous`` block,
     per-host process id via $TPUDDP_PROCESS_ID, no library code written by the

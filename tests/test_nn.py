@@ -358,3 +358,54 @@ def test_divergent_state_protocol():
     assert not has_divergent_buffers(nn.BatchNorm(sync=True))
     assert not has_divergent_buffers(nn.BatchNorm(track_running_stats=False))
     assert not has_divergent_buffers(nn.Sequential(nn.Conv2d(4, 3), nn.ReLU()))
+
+
+# --------------------------------------------- transformer-family layers --
+
+
+def test_layernorm_matches_torch():
+    x = np.random.RandomState(7).randn(4, 9, 32).astype(np.float32) * 3 + 1
+    layer = nn.LayerNorm()
+    params, state = layer.init(KEY, jnp.asarray(x))
+    assert params["scale"].shape == (32,) and params["bias"].shape == (32,)
+    # non-trivial affine so the test covers scale/bias application too
+    params = {
+        "scale": jnp.asarray(np.random.RandomState(8).randn(32), jnp.float32),
+        "bias": jnp.asarray(np.random.RandomState(9).randn(32), jnp.float32),
+    }
+    y, state2 = layer.apply(params, state, jnp.asarray(x), ctx_train())
+    assert state2 == state  # no buffers, nothing diverges
+    ref = F.layer_norm(
+        torch.from_numpy(x), (32,),
+        torch.from_numpy(np.asarray(params["scale"])),
+        torch.from_numpy(np.asarray(params["bias"])),
+    ).numpy()
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+    # train and eval are the same math (per-sample statistics)
+    y_eval, _ = layer.apply(params, state, jnp.asarray(x), nn.Context())
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y_eval))
+
+
+def test_layernorm_no_affine_and_no_divergent_buffers():
+    x = jnp.asarray(np.random.RandomState(10).randn(2, 8).astype(np.float32))
+    layer = nn.LayerNorm(affine=False)
+    params, _ = layer.init(KEY, x)
+    assert params == {}
+    y, _ = layer.apply(params, (), x, nn.Context())
+    out = np.asarray(y)
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), 1.0, atol=1e-3)
+    assert not layer.divergent_state()
+
+
+def test_embedding_lookup_and_shape():
+    layer = nn.Embedding(10, 6)
+    params, state = layer.init(KEY, jnp.zeros((2, 3), jnp.int32))
+    assert params["weight"].shape == (10, 6)  # torch (num_embeddings, dim)
+    ids = jnp.asarray([[1, 4], [9, 0]], jnp.int32)
+    y, _ = layer.apply(params, state, ids, nn.Context())
+    assert y.shape == (2, 2, 6)
+    np.testing.assert_array_equal(
+        np.asarray(y[1, 0]), np.asarray(params["weight"][9])
+    )
+    assert not layer.divergent_state()

@@ -346,8 +346,14 @@ def test_bench_payload_validator(tmp_path):
     p.write_text(json.dumps(payload, indent=2))
     errors, n = schema_mod.validate_bench_file(str(p))
     assert errors == [] and n == 1
+    # a decode row satisfies the rate requirement with tokens_per_sec alone
+    tok = dict(payload)
+    tok["configs"] = {"row": {"tokens_per_sec": 9.0, "ms_per_step": 2.0}}
+    assert schema_mod.validate_bench_payload(tok) == []
     bad = dict(payload)
     bad["configs"] = {"row": {"ms_per_step": 2.0}}
+    assert any("needs one of" in e for e in schema_mod.validate_bench_payload(bad))
+    bad["configs"] = {"row": {"samples_per_sec_per_chip": 1.0}}
     assert any("missing field" in e for e in schema_mod.validate_bench_payload(bad))
     del bad["metric"]
     assert any("'metric'" in e for e in schema_mod.validate_bench_payload(bad))

@@ -1,21 +1,29 @@
 #!/usr/bin/env python
 """bench_trend — the per-row benchmark trajectory + regression gate.
 
-Reads every committed ``BENCH_r*.json`` at the repo root (plus, with
-``--fresh``, an uncommitted run's ``bench_results.json``), normalizes the
-two artifact shapes the repo has accumulated — the raw driver capture
-(``{cmd, parsed, tail, ...}``, r01–r05) and the direct bench payload
-(``{metric, configs, ...}``, r06+) — and prints each config row's
-samples/sec + MFU trajectory across releases.
+Reads every committed ``BENCH_r*.json`` AND ``SERVING_r*.json`` at the repo
+root (plus, with ``--fresh``, an uncommitted run's ``bench_results.json``),
+normalizes the two artifact shapes the repo has accumulated — the raw
+driver capture (``{cmd, parsed, tail, ...}``, r01–r05) and the direct bench
+payload (``{metric, configs, ...}``, r06+) — and prints each config row's
+rate + MFU trajectory across releases.
 
-Regression rule: the CANDIDATE (the ``--fresh`` artifact when given, else
-the newest committed one) is compared row by row against the BEST earlier
-value of the same row name **on the same device** (a CPU-rung run must
-never be judged against a TPU row of the same name). Any candidate row
-whose ``samples_per_sec_per_chip`` falls more than ``--threshold`` (default
-10%) below its historical best exits nonzero — wired into
-``tools/run_full_gate.py`` so a perf regression fails the gate like a
-schema drift does.
+Rows carry one of two RATE metrics and the trend tracks either, never
+mixing them: training and request-granularity serving rows report
+``samples_per_sec_per_chip``; autoregressive decode rows (``tools/loadgen
+--decode``, tpuddp/serving/decode/) report ``tokens_per_sec`` (rendered
+with a ``t/s`` suffix). A row name that appears under both metrics — e.g.
+``closed_loop`` in a request-serving and a decode artifact — is judged per
+metric, so a decode row is never regressed against a request-rate best.
+
+Regression rule: each CANDIDATE (the ``--fresh`` artifact when given, else
+the newest committed artifact of each family — BENCH and SERVING) is
+compared row by row against the BEST earlier value of the same row name
+and rate metric **on the same device** (a CPU-rung run must never be
+judged against a TPU row of the same name). Any candidate row whose rate
+falls more than ``--threshold`` (default 10%) below its historical best
+exits nonzero — wired into ``tools/run_full_gate.py`` so a perf regression
+fails the gate like a schema drift does.
 
 Usage:
     python tools/bench_trend.py                       # committed trajectory
@@ -86,25 +94,38 @@ def normalize(path):
     return tag, payload.get("device") or "unknown", configs
 
 
+_FAMILIES = ("BENCH_r*.json", "SERVING_r*.json")
+
+
 def load_artifacts(fresh=None, repo=_REPO):
-    """Committed BENCH_r*.json (release order) + the optional fresh run."""
+    """Committed BENCH_r*.json + SERVING_r*.json (release order within each
+    family) + the optional fresh run. Returns ``(artifacts, candidates)``:
+    with ``--fresh`` the fresh artifact is the sole candidate, otherwise the
+    newest committed artifact of EACH family is judged."""
     artifacts = []
-    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
-        norm = normalize(path)
-        if norm is None:
-            print(f"bench_trend: {os.path.basename(path)} carries no config "
-                  "rows (skipped)")
-            continue
-        artifacts.append(norm)
+    candidates = []
+    for pattern in _FAMILIES:
+        family = []
+        for path in sorted(glob.glob(os.path.join(repo, pattern))):
+            norm = normalize(path)
+            if norm is None:
+                print(f"bench_trend: {os.path.basename(path)} carries no "
+                      "config rows (skipped)")
+                continue
+            family.append(norm)
+        artifacts.extend(family)
+        if family and not fresh:
+            candidates.append(family[-1])
     if fresh:
         norm = normalize(fresh)
         if norm is None:
             print(f"bench_trend: --fresh {fresh} carries no config rows",
                   file=sys.stderr)
-            return artifacts, None
+            return artifacts, []
         norm = (f"fresh:{norm[0]}", norm[1], norm[2])
         artifacts.append(norm)
-    return artifacts, artifacts[-1] if artifacts else None
+        candidates = [norm]
+    return artifacts, candidates
 
 
 def _num(row, key):
@@ -112,8 +133,26 @@ def _num(row, key):
     return float(v) if isinstance(v, (int, float)) else None
 
 
+# The two rate metrics a config row may carry (schema._BENCH_ROW_RATES):
+# samples/sec/chip for training + request-granularity serving rows,
+# tokens/sec for autoregressive decode rows. Trend cells and regression
+# comparisons are always per (row name, device, rate metric).
+_RATE_KEYS = ("samples_per_sec_per_chip", "tokens_per_sec")
+
+
+def _rate(row):
+    """``(key, value)`` of the row's rate metric, or ``(None, None)``."""
+    for key in _RATE_KEYS:
+        v = _num(row, key)
+        if v is not None:
+            return key, v
+    return None, None
+
+
 def print_trajectory(artifacts) -> None:
-    """Per-row samples/sec (and MFU where known) across releases."""
+    """Per-row rate (and MFU where known) across releases. Decode rows show
+    their tokens/sec with a ``t/s`` suffix so the two rate families never
+    read as one number."""
     rows = []
     seen = []
     for _tag, device, configs in artifacts:
@@ -128,9 +167,14 @@ def print_trajectory(artifacts) -> None:
             if row is None:
                 cells.append("-")
                 continue
-            sps = _num(row, "samples_per_sec_per_chip")
+            key, rate = _rate(row)
             mfu = _num(row, "mfu")
-            cell = f"{sps:,.0f}" if sps is not None else "?"
+            if rate is None:
+                cell = "?"
+            else:
+                cell = f"{rate:,.0f}"
+                if key == "tokens_per_sec":
+                    cell += "t/s"
             if mfu is not None:
                 cell += f"/{mfu:.3f}"
             cells.append(cell)
@@ -143,18 +187,20 @@ def print_trajectory(artifacts) -> None:
     print("-" * (sum(widths) + 2 * (len(widths) - 1)))
     for r in rows:
         print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
-    print("(cells: samples/sec/chip, '/MFU' where recorded)")
+    print("(cells: samples/sec/chip — or tokens/sec marked 't/s' — "
+          "'/MFU' where recorded)")
 
 
 def check_regressions(artifacts, candidate, threshold: float):
-    """Candidate rows vs their same-device historical best. Returns the
-    list of regression description strings (empty = pass)."""
+    """Candidate rows vs their same-device, same-rate-metric historical
+    best. Returns the list of regression description strings (empty =
+    pass)."""
     cand_tag, cand_device, cand_configs = candidate
     history = [a for a in artifacts if a[0] != cand_tag]
     regressions = []
     for name, row in cand_configs.items():
-        sps = _num(row, "samples_per_sec_per_chip")
-        if sps is None:
+        rate_key, rate = _rate(row)
+        if rate is None:
             continue
         best = None
         best_tag = None
@@ -164,15 +210,19 @@ def check_regressions(artifacts, candidate, threshold: float):
             prev = configs.get(name)
             if prev is None:
                 continue
-            prev_sps = _num(prev, "samples_per_sec_per_chip")
-            if prev_sps is not None and (best is None or prev_sps > best):
-                best, best_tag = prev_sps, tag
+            prev_rate = _num(prev, rate_key)
+            if prev_rate is not None and (best is None or prev_rate > best):
+                best, best_tag = prev_rate, tag
         if best is None or best <= 0:
             continue
-        drop = 1.0 - sps / best
+        drop = 1.0 - rate / best
         if drop > threshold:
+            unit = (
+                "tokens/s" if rate_key == "tokens_per_sec"
+                else "samples/s/chip"
+            )
             regressions.append(
-                f"{name!r} on {cand_device}: {sps:,.1f} samples/s/chip in "
+                f"{name!r} on {cand_device}: {rate:,.1f} {unit} in "
                 f"{cand_tag} is {drop * 100:.1f}% below the best "
                 f"{best:,.1f} ({best_tag}) — over the "
                 f"{threshold * 100:.0f}% floor"
@@ -195,14 +245,15 @@ def main(argv=None) -> int:
     parser.add_argument("--repo", default=_REPO, help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
 
-    artifacts, candidate = load_artifacts(args.fresh, repo=args.repo)
+    artifacts, candidates = load_artifacts(args.fresh, repo=args.repo)
     if not artifacts:
-        # a fresh clone (no committed BENCH_r*.json yet) has no trajectory
-        # to regress against — that is an empty gate, not a failure
-        print("bench_trend: no BENCH_r*.json artifacts with config rows "
-              "found — nothing to compare, nothing to regress (exit 0)")
+        # a fresh clone (no committed BENCH_r*/SERVING_r* artifacts yet) has
+        # no trajectory to regress against — an empty gate, not a failure
+        print("bench_trend: no BENCH_r*/SERVING_r*.json artifacts with "
+              "config rows found — nothing to compare, nothing to regress "
+              "(exit 0)")
         return 0
-    if candidate is None:
+    if not candidates:
         # --fresh pointed at an artifact with no config rows: report the
         # committed trajectory, but there is no candidate to judge
         print_trajectory(artifacts)
@@ -210,13 +261,16 @@ def main(argv=None) -> int:
               "no candidate to judge (exit 0)")
         return 0
     print_trajectory(artifacts)
-    regressions = check_regressions(artifacts, candidate, args.threshold)
+    regressions = []
+    for candidate in candidates:
+        regressions += check_regressions(artifacts, candidate, args.threshold)
     if regressions:
         for r in regressions:
             print(f"REGRESSION: {r}", file=sys.stderr)
         return 1
-    print(f"bench_trend: no row of candidate {candidate[0]} regressed more "
-          f"than {args.threshold * 100:.0f}% against its same-device best")
+    print(f"bench_trend: no row of candidate(s) "
+          f"{', '.join(c[0] for c in candidates)} regressed more than "
+          f"{args.threshold * 100:.0f}% against its same-device best")
     return 0
 
 

@@ -25,9 +25,17 @@ Runs entirely in-process on the local mesh (CPU-friendly: the gate's serving
 leg drives ~100 requests against 2 replicas over 2 tenants); the same flags
 scale the sweep up on real chips.
 
+``--decode`` switches to the TOKEN-level engine (tpuddp/serving/decode/):
+the curve becomes tokens/sec + time-to-first-token vs offered request rate,
+and ``vs_baseline`` anchors against request-level SEQUENTIAL decode (one
+sequence in flight, no continuous batching — the regime the decode engine
+exists to beat). Rows carry ``tokens_per_sec`` instead of
+``samples_per_sec_per_chip``; ``tools/bench_trend.py`` tracks either.
+
 Usage:
     python tools/loadgen.py --quick --history-dir /tmp/serve \\
         --out /tmp/serve/bench_results.json
+    python tools/loadgen.py --decode --quick --history-dir /tmp/decode
 """
 
 from __future__ import annotations
@@ -146,6 +154,295 @@ def raw_dispatch_rate(engine, payloads_1row, steps):
     return steps / dt
 
 
+def _decode_prompts(rng, n, max_prompt, vocab):
+    return [
+        rng.randint(0, vocab, size=int(rng.randint(1, max_prompt + 1))).astype(
+            np.int32
+        )
+        for _ in range(n)
+    ]
+
+
+class _occupancy_peak:
+    """Context manager sampling ``engine.kv_occupancy()`` on a background
+    thread while the phase runs — the loop drains every sequence before
+    returning, so a post-hoc read always sees an EMPTY pool (0.0), never
+    the pressure the phase actually applied. Enter yields a zero-arg
+    callable returning the max observed so far."""
+
+    def __init__(self, engine, interval_s: float = 0.005):
+        self._engine = engine
+        self._interval = interval_s
+        self._peak = 0.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._peak = max(self._peak, self._engine.kv_occupancy())
+            self._stop.wait(self._interval)
+
+    def __enter__(self):
+        self._thread.start()
+        return lambda: self._peak
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        return False
+
+
+def decode_closed_loop(engine, prompts, tenants, workers):
+    """Workers each keep one SEQUENCE in flight (submit -> stream to the
+    end -> repeat). Returns (completed count, wall_s)."""
+    from tpuddp.serving import AdmissionError
+
+    lock = threading.Lock()
+    cursor = {"i": 0, "done": 0}
+
+    def run(_w):
+        while True:
+            with lock:
+                i = cursor["i"]
+                if i >= len(prompts):
+                    return
+                cursor["i"] = i + 1
+            try:
+                res = engine.submit(f"tenant{i % tenants}", prompts[i])
+            except AdmissionError:
+                continue
+            res.result(timeout=300)
+            with lock:
+                cursor["done"] += 1
+
+    threads = [
+        threading.Thread(target=run, args=(w,), daemon=True)
+        for w in range(workers)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return cursor["done"], time.perf_counter() - t0
+
+
+def decode_open_loop(engine, prompts, tenants, offered_rps):
+    """Fixed-rate sequence arrivals; returns (completed, rejected, wall_s)."""
+    from tpuddp.serving import AdmissionError
+
+    interval = 1.0 / offered_rps
+    inflight = []
+    rejected = 0
+    t_start = time.perf_counter()
+    for i, p in enumerate(prompts):
+        target = t_start + i * interval
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            inflight.append(engine.submit(f"tenant{i % tenants}", p))
+        except AdmissionError:
+            rejected += 1
+    for res in inflight:
+        res.result(timeout=300)
+    return len(inflight), rejected, time.perf_counter() - t_start
+
+
+def _decode_row(name, mode, d, offered_rps=None, **extra):
+    """One bench-format row from a DecodeStats.since delta: the token-rate
+    family (tokens_per_sec + TTFT/ITL) instead of samples/sec/chip."""
+    return {
+        name: {
+            "mode": mode,
+            "offered_rps": offered_rps,
+            "achieved_rps": round(d["completed"] / max(d["wall_s"], 1e-9), 2),
+            "requests": d["submitted"],
+            "completed": d["completed"],
+            "rejected": d["rejected"],
+            "tokens": d["tokens"],
+            "tokens_per_sec": d["tokens_per_sec"],
+            **{f"ttft_ms_{k}": v for k, v in d["ttft_ms"].items()
+               if k in ("p50", "p95", "p99")},
+            **{f"itl_ms_{k}": v for k, v in d["itl_ms"].items()
+               if k in ("p50", "p95", "p99")},
+            # the decode path's "step" is one token: ITL p50 is its ms/step
+            "ms_per_step": d["itl_ms"]["p50"],
+            **extra,
+        }
+    }
+
+
+def run_decode(args) -> int:
+    """The --decode sweep: tokens/sec + TTFT vs offered sequence rate, with
+    request-level sequential decode as the vs_baseline anchor."""
+    from tpuddp import config as config_lib
+    from tpuddp.observability import json_sanitize
+    from tpuddp.serving.decode import DecodeEngine
+
+    settings = (
+        config_lib.load_settings(args.settings) if args.settings else {}
+    )
+    serving = config_lib.serving_config(settings)
+    cfg = config_lib.decode_config(serving) or dict(config_lib.DECODE_DEFAULTS)
+    if args.model:
+        cfg["model"] = args.model
+    if args.replicas:
+        cfg["num_replicas"] = args.replicas
+    n_per_load = args.requests
+    if args.quick:
+        # CI sizing: tiny vocab/model state, short generations, ~100
+        # sequences across calibration + 3 open points
+        n_per_load = 24
+        cfg.update(
+            vocab_size=min(int(cfg["vocab_size"]), 64),
+            max_slots=min(int(cfg["max_slots"]), 4),
+            max_seq_len=min(int(cfg["max_seq_len"]), 64),
+            max_new_tokens=min(int(cfg["max_new_tokens"]), 8),
+            stats_window=32,
+        )
+
+    observability = None
+    if args.exporter is not None:
+        observability = {"exporter": True, "exporter_port": args.exporter}
+    engine = DecodeEngine.from_config(
+        cfg, out_dir=args.history_dir, observability=observability
+    )
+    log(
+        f"decode engine: model={cfg['model']} replicas={len(engine.replicas)} "
+        f"max_slots={cfg['max_slots']} kv={cfg['kv_blocks']}x"
+        f"{cfg['kv_block_size']} prefill_buckets={engine.buckets}"
+    )
+    engine.start()
+    if engine.exporter is not None:
+        log(f"exporter: /metrics on {engine.exporter.host}:{engine.exporter.port}")
+
+    rng = np.random.RandomState(args.seed)
+    max_prompt = min(16, engine.max_prompt_len)
+    configs = {}
+
+    # -- correctness proof before any timing: a sequence decoded inside a
+    # full concurrent batch must be BITWISE the sequence decoded alone —
+    # continuous batching and KV paging are numerically invisible
+    probe = _decode_prompts(rng, 1 + int(cfg["max_slots"]), max_prompt,
+                            engine.vocab_size)
+    solo = engine.submit("verify", probe[0], seed=123).result(timeout=300)
+    crowd = [engine.submit("verify", p, seed=123) for p in probe]
+    packed = crowd[0].result(timeout=300)
+    for r in crowd[1:]:
+        r.result(timeout=300)
+    if not np.array_equal(solo, packed):
+        log("FATAL: batched decode diverged from single-sequence decode")
+        return 1
+    log("verified: batched decode bitwise-equal to single-sequence decode")
+
+    # -- baseline: request-level SEQUENTIAL decode (one sequence in flight,
+    # the no-continuous-batching strawman) through the same engine
+    # one-sequence-in-flight decode is the slowest phase of the sweep: cap
+    # it in the full run (the quick sizing is already tiny) — 64 sequences
+    # is plenty of signal for a tokens/sec anchor
+    base_n = n_per_load if args.quick else min(n_per_load, 64)
+    base_prompts = _decode_prompts(rng, base_n, max_prompt, engine.vocab_size)
+    m = engine.stats.mark()
+    decode_closed_loop(engine, base_prompts, args.tenants, workers=1)
+    d_base = engine.stats.since(m)
+    base_tps = d_base["tokens_per_sec"]
+    configs.update(_decode_row("sequential_baseline", "sequential", d_base))
+    log(
+        f"baseline (sequential, 1 sequence in flight): {base_tps:,.1f} "
+        f"tokens/s, TTFT p50 {d_base['ttft_ms']['p50']} ms"
+    )
+
+    # -- closed loop: saturate the slots, find the peak token rate
+    workers = args.workers or 2 * int(cfg["max_slots"]) * len(engine.replicas)
+    prompts = _decode_prompts(rng, n_per_load, max_prompt, engine.vocab_size)
+    m = engine.stats.mark()
+    with _occupancy_peak(engine) as kv_peak:
+        done, wall = decode_closed_loop(engine, prompts, args.tenants, workers)
+    d = engine.stats.since(m)
+    peak_tps = d["tokens_per_sec"]
+    peak_rps = done / max(wall, 1e-9)
+    configs.update(_decode_row(
+        "closed_loop", "closed", d, workers=workers,
+        kv_occupancy_peak=round(kv_peak(), 4),
+    ))
+    log(
+        f"closed loop ({workers} workers): {peak_tps:,.1f} tokens/s "
+        f"({peak_rps:,.1f} seq/s), TTFT p50 {d['ttft_ms']['p50']} ms, "
+        f"ITL p50 {d['itl_ms']['p50']} ms"
+    )
+
+    # -- open loop: TTFT/ITL vs offered sequence rate
+    fractions = [float(f) for f in args.loads.split(",") if f.strip()]
+    for frac in fractions:
+        offered = max(0.5, peak_rps * frac)
+        prompts = _decode_prompts(rng, n_per_load, max_prompt, engine.vocab_size)
+        m = engine.stats.mark()
+        _, rejected, _ = decode_open_loop(engine, prompts, args.tenants, offered)
+        d = engine.stats.since(m)
+        name = f"open_{frac:g}x"
+        configs.update(_decode_row(
+            name, "open", d,
+            offered_rps=round(offered, 2),
+            offered_fraction_of_peak=frac,
+        ))
+        log(
+            f"open loop {frac:g}x ({offered:,.1f} seq/s offered): "
+            f"{d['tokens_per_sec']:,.1f} tokens/s, TTFT p50 "
+            f"{d['ttft_ms']['p50']} ms, ITL p99 {d['itl_ms']['p99']} ms, "
+            f"rejected {rejected}"
+        )
+
+    summary = engine.drain(reason="loadgen_complete")
+
+    import jax
+
+    device_kind = jax.devices()[0].device_kind
+    vs = peak_tps / base_tps if base_tps else 1.0
+    payload = {
+        "metric": f"decode_{cfg['model']}_tokens_per_sec",
+        "value": round(peak_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(vs, 2),
+        "vs_baseline_basis": "request-level sequential decode (1 sequence in flight)",
+        "baseline_tokens_per_sec": round(base_tps, 2),
+        "device": device_kind,
+        "tenants": args.tenants,
+        "replicas": len(engine.replicas),
+        "max_slots": int(cfg["max_slots"]),
+        "kv_blocks": int(cfg["kv_blocks"]),
+        "kv_block_size": int(cfg["kv_block_size"]),
+        "max_new_tokens": int(cfg["max_new_tokens"]),
+        "configs": configs,
+    }
+    out_path = args.out or (
+        os.path.join(args.history_dir, "bench_results.json")
+        if args.history_dir
+        else os.path.join(_REPO, "bench_results.json")
+    )
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(json_sanitize(payload), f, indent=2, allow_nan=False)
+        f.write("\n")
+    log(f"token curve -> {out_path}")
+    if args.history_dir:
+        log(f"history -> {os.path.join(args.history_dir, 'history.jsonl')}")
+
+    print(json.dumps(json_sanitize({
+        "metric": payload["metric"],
+        "value": payload["value"],
+        "unit": payload["unit"],
+        "vs_baseline": payload["vs_baseline"],
+        "device": device_kind,
+        "n_configs": len(configs),
+        "completed": summary["completed"],
+        "tokens": summary["tokens"],
+        "rejected": sum(summary["rejected"].values()),
+        "results_file": os.path.basename(out_path),
+    }), allow_nan=False))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     parser.add_argument("--settings", default=None,
@@ -171,12 +468,18 @@ def main(argv=None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--quick", action="store_true",
                         help="CI sizing: ~100 requests total, tiny model")
+    parser.add_argument("--decode", action="store_true",
+                        help="token-level decode sweep (tokens/sec + TTFT "
+                        "curves against the serving.decode engine)")
     parser.add_argument("--exporter", nargs="?", const=0, default=None,
                         type=int, metavar="PORT",
                         help="serve the live /metrics endpoint during the "
                         "run (PORT omitted or 0 = ephemeral; the bound port "
                         "lands in <history-dir>/exporter.port)")
     args = parser.parse_args(argv)
+
+    if args.decode:
+        return run_decode(args)
 
     from tpuddp import config as config_lib
     from tpuddp.observability import json_sanitize

@@ -47,7 +47,18 @@ events) and the latency-vs-throughput ``bench_results.json`` curve — must
 pass ``tpuddp_inspect --validate``. The serving SLO record stream drifting
 off schema v2 fails the gate the same way training telemetry drift does.
 
-Elastic-resume gate (after the serving gate): a bf16_ef training run on 4
+Decode gate (after the serving gate): ``tools/loadgen.py --decode --quick``
+stands the TOKEN-level autoregressive engine (tpuddp/serving/decode/) up on
+the CPU mesh — transformer prefill/decode split, paged KV cache, continuous
+batching at token granularity — and both artifacts (the schema-v6
+``history.jsonl`` with run_meta decode provenance + decode_stats windows,
+and the tokens/sec + TTFT ``bench_results.json`` curve) must pass
+``tpuddp_inspect --validate``. Then the drain leg: a ``--decode`` server
+is SIGTERMed mid-decode and must let every in-flight sequence finish
+streaming (summary ``completed == submitted``, zero truncation) before
+exiting 75 — the resilience drain contract at token granularity.
+
+Elastic-resume gate (after the decode gate): a bf16_ef training run on 4
 local devices is preempted (injected SIGTERM -> exit 75, emergency
 checkpoint), then resumed on 2 devices THROUGH the restart supervisor
 (tools/supervise.py) — the v2 checkpoint reshards onto the smaller world.
@@ -174,6 +185,157 @@ def _serving_gate(env) -> int:
                     "validation", file=sys.stderr,
                 )
                 return rc
+    return 0
+
+
+def _decode_gate(env) -> int:
+    """Decode leg (ISSUE 12): (a) loadgen's --quick token sweep on the CPU
+    mesh with both artifacts schema-validated; (b) the drain contract — a
+    SIGTERM landing mid-decode must let every in-flight sequence finish
+    streaming (completed == submitted, nothing truncated) and exit 75."""
+    import json
+    import signal
+    import time
+
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    with tempfile.TemporaryDirectory(prefix="tpuddp_decode_gate_") as tmp:
+        base_env = dict(env)
+        base_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        # -- leg a: the token sweep + artifact validation
+        sweep_dir = os.path.join(tmp, "sweep")
+        os.makedirs(sweep_dir)
+        bench_json = os.path.join(sweep_dir, "bench_results.json")
+        rc = subprocess.call(
+            [
+                sys.executable, "-u",
+                os.path.join(REPO, "tools", "loadgen.py"),
+                "--decode", "--quick", "--replicas", "2", "--tenants", "2",
+                "--history-dir", sweep_dir, "--out", bench_json,
+            ],
+            cwd=REPO, env=base_env,
+        )
+        if rc != 0:
+            print(f"decode gate: loadgen --decode exited {rc}",
+                  file=sys.stderr)
+            return rc
+        for artifact in (os.path.join(sweep_dir, "history.jsonl"), bench_json):
+            rc = subprocess.call(
+                [sys.executable, inspect, "--validate", artifact],
+                cwd=REPO, env=env,
+            )
+            if rc != 0:
+                print(f"decode gate: {os.path.basename(artifact)} failed "
+                      "validation", file=sys.stderr)
+                return rc
+        # -- leg b: SIGTERM mid-decode -> finish in-flight streams -> 75
+        out_dir = os.path.join(tmp, "drain")
+        settings = os.path.join(tmp, "settings.yaml")
+        with open(settings, "w") as f:
+            f.write(
+                "out_dir: %s\n"
+                "serving:\n"
+                "  decode:\n"
+                "    vocab_size: 64\n"
+                "    max_slots: 4\n"
+                "    kv_blocks: 65\n"
+                "    kv_block_size: 8\n"
+                "    max_seq_len: 128\n"
+                # 24 sequences x 96 tokens on 4 slots is seconds of decode
+                # on the CPU mesh — the SIGTERM below cannot miss the window,
+                # and the in_flight_at_drain assertion proves it didn't
+                "    max_new_tokens: 96\n"
+                "    stats_window: 32\n" % out_dir
+            )
+        n_demo = 24
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-u", "-m", "tpuddp.serving",
+                "--settings", settings, "--decode",
+                "--demo", str(n_demo), "--serve", "120",
+            ],
+            cwd=REPO, env=base_env,
+            stdout=subprocess.PIPE, text=True,
+        )
+        import threading
+
+        # stdout is drained by a daemon thread so the readiness wait below
+        # can enforce a REAL deadline — a blocking readline here would hang
+        # the whole gate on a server wedged before its first output line
+        lines = []
+        ready = threading.Event()
+
+        def _drain_stdout():
+            for line in proc.stdout:
+                lines.append(line)
+                if line.strip() == "serving: ready":
+                    ready.set()
+
+        reader = threading.Thread(target=_drain_stdout, daemon=True)
+        reader.start()
+        try:
+            # demo prompts are submitted (NOT waited) before the ready line,
+            # so a SIGTERM here lands with sequences genuinely in flight
+            deadline = time.time() + 300
+            while (time.time() < deadline and not ready.is_set()
+                   and proc.poll() is None):
+                time.sleep(0.2)
+            if not ready.is_set():
+                proc.kill()
+                print("decode gate: server never reached 'serving: ready' "
+                      f"(rc {proc.poll()})", file=sys.stderr)
+                return 1
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=300)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                print("decode gate: drain hung after SIGTERM",
+                      file=sys.stderr)
+                return 1
+            reader.join(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        if proc.returncode != 75:
+            print(f"decode gate: drained server exited {proc.returncode}, "
+                  "expected 75", file=sys.stderr)
+            return proc.returncode or 1
+        summary = json.loads([l for l in lines if l.strip()][-1])
+        if summary.get("completed") != n_demo or summary.get("submitted") != n_demo:
+            print(
+                "decode gate: drain truncated in-flight sequences "
+                f"(submitted {summary.get('submitted')}, completed "
+                f"{summary.get('completed')}, expected {n_demo})",
+                file=sys.stderr,
+            )
+            return 1
+        if not summary.get("in_flight_at_drain"):
+            # completed == submitted proves nothing if the engine was idle
+            # when the signal landed — the drain contract is only exercised
+            # when sequences were genuinely mid-stream
+            print(
+                "decode gate: SIGTERM landed on an idle engine "
+                f"(in_flight_at_drain={summary.get('in_flight_at_drain')}); "
+                "the drain contract was not exercised",
+                file=sys.stderr,
+            )
+            return 1
+        rc = subprocess.call(
+            [sys.executable, inspect, "--validate",
+             os.path.join(out_dir, "history.jsonl")],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("decode gate: drained server history failed validation",
+                  file=sys.stderr)
+            return rc
+        print("decode gate: token sweep artifacts valid + SIGTERM drain "
+              f"finished all {n_demo} in-flight sequences (exit 75)")
     return 0
 
 
@@ -686,6 +848,9 @@ def main(argv=None):
     if rc != 0:
         return rc
     rc = _serving_gate(env)
+    if rc != 0:
+        return rc
+    rc = _decode_gate(env)
     if rc != 0:
         return rc
     rc = _elastic_gate(env)

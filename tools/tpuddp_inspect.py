@@ -3,11 +3,13 @@
 
 Works on both machine-readable artifacts the framework writes:
 
-- ``history.jsonl`` (a training run's typed record stream:
-  ``run_meta`` / ``epoch`` / ``step_stats`` / ``event``,
-  tpuddp/observability/schema.py) — prints the run header, a per-epoch
-  table with step-time percentiles, the event timeline, and the
-  gradient-comm byte savings a compressed hook achieved;
+- ``history.jsonl`` (a run's typed record stream: ``run_meta`` / ``epoch``
+  / ``step_stats`` / ``event`` / ``serving_stats`` / ``decode_stats``,
+  tpuddp/observability/schema.py) — prints the run header (including the
+  schema-v6 decode provenance block for autoregressive runs), a per-epoch
+  table with step-time percentiles, serving/decode SLO window tables
+  (tokens/sec, TTFT, ITL, KV occupancy for decode), the event timeline,
+  and the gradient-comm byte savings a compressed hook achieved;
 - ``bench_results.json`` (the bench harness's full per-config payload);
 - ``flightrec_<reason>.json`` (the crash flight recorder's post-mortem
   sidecar, tpuddp/observability/flight.py) — validates the ring contents
@@ -131,6 +133,7 @@ def summarize_history(path: str) -> None:
         "type" not in r and "event" in r)]
     steps = [r for r in records if r.get("type") == "step_stats"]
     serving = [r for r in records if r.get("type") == "serving_stats"]
+    decode = [r for r in records if r.get("type") == "decode_stats"]
 
     if metas:
         m = metas[-1]
@@ -152,6 +155,20 @@ def summarize_history(path: str) -> None:
         guard = m.get("guard")
         if isinstance(guard, dict) and guard.get("enabled"):
             print(f"  {'guard':>20}: {guard}")
+        # decode provenance (required since schema v6; null = not an
+        # autoregressive run): the KV-pool geometry + sampling contract
+        dec = m.get("decode")
+        if isinstance(dec, dict):
+            geom = (
+                f"{dec.get('kv_blocks')}x{dec.get('kv_block_size')} KV "
+                f"blocks, {dec.get('max_slots')} slots, max_seq_len "
+                f"{dec.get('max_seq_len')}"
+            )
+            print(f"  {'decode':>20}: model={dec.get('model')} "
+                  f"vocab={dec.get('vocab_size')} {geom}")
+            print(f"  {'':>20}  temperature={dec.get('temperature')} "
+                  f"stop_token={dec.get('stop_token')} "
+                  f"prefill_buckets={dec.get('prefill_buckets')}")
     else:
         print("run_meta: MISSING (pre-schema history?)")
 
@@ -235,6 +252,39 @@ def summarize_history(path: str) -> None:
         worst = max((s.get("e2e_ms_p99") or 0) for s in serving)
         print(f"  totals: {done} completed, {rej} rejected, "
               f"worst-window e2e p99 {worst:.2f} ms")
+
+    if decode:
+        # token-level SLO windows (schema v6, tpuddp/serving/decode/):
+        # throughput in tokens/sec plus the two latencies token traffic
+        # lives by — TTFT (submit -> first streamed token) and ITL (gap
+        # between consecutive tokens of one sequence) — and KV-pool pressure
+        print(f"\ndecode_stats windows ({len(decode)}):")
+        rows = []
+        for s in decode:
+            rows.append([
+                str(s.get("window")),
+                str(s.get("tokens")),
+                str(s.get("completed")),
+                str(s.get("rejected")),
+                _fmt(s.get("tokens_per_sec"), 0),
+                _fmt(s.get("ttft_ms_p50"), 2),
+                _fmt(s.get("ttft_ms_p95"), 2),
+                _fmt(s.get("itl_ms_p50"), 2),
+                _fmt(s.get("itl_ms_p99"), 2),
+                _fmt(s.get("kv_occupancy"), 3),
+                str(s.get("active_sequences")
+                    if s.get("active_sequences") is not None else "-"),
+            ])
+        _print_table(rows, [
+            "win", "tok", "done", "rej", "tok/s", "ttft50", "ttft95",
+            "itl50", "itl99", "kvocc", "act",
+        ])
+        tok = sum(s.get("tokens") or 0 for s in decode)
+        done = sum(s.get("completed") or 0 for s in decode)
+        worst_itl = max((s.get("itl_ms_p99") or 0) for s in decode)
+        peak_kv = max((s.get("kv_occupancy") or 0) for s in decode)
+        print(f"  totals: {tok} tokens across {done} sequences, worst-window "
+              f"ITL p99 {worst_itl:.2f} ms, peak KV occupancy {peak_kv:.3f}")
 
     # gradient-comm byte savings: compressed vs the f32 baseline the header
     # records. ONLY the latest run segment's epochs belong to the latest
@@ -358,6 +408,31 @@ def summarize_bench(path: str) -> None:
         _print_table(rows, [
             "config", "hook", "topo", "sps/chip", "ms", "wire B/step",
             "interB", "cut", "loss",
+        ])
+        return
+    if any(
+        isinstance(r, dict) and "tokens_per_sec" in r for r in configs.values()
+    ):
+        # decode token-curve rows (tools/loadgen.py --decode): tokens/sec +
+        # TTFT/ITL vs offered sequence rate, with the sequential-decode
+        # baseline row anchoring vs_baseline
+        rows = []
+        for name, r in configs.items():
+            rows.append([
+                name,
+                str(r.get("mode", "-")),
+                _fmt(r.get("offered_rps"), 1),
+                _fmt(r.get("achieved_rps"), 1),
+                _fmt(r.get("tokens_per_sec"), 0),
+                _fmt(r.get("ttft_ms_p50"), 2),
+                _fmt(r.get("ttft_ms_p95"), 2),
+                _fmt(r.get("itl_ms_p50"), 2),
+                _fmt(r.get("itl_ms_p99"), 2),
+                str(r.get("rejected", "-")),
+            ])
+        _print_table(rows, [
+            "config", "mode", "offered", "seq/s", "tok/s", "ttft50",
+            "ttft95", "itl50", "itl99", "rej",
         ])
         return
     if any(isinstance(r, dict) and "offered_rps" in r for r in configs.values()):

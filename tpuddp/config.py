@@ -142,7 +142,61 @@ SERVING_DEFAULTS = {
     # replica_unhealthy event row); healthy replicas keep serving. 0 never
     # marks (every batch on a broken replica fails individually).
     "seed": 0,  # fresh-init parameter seed (ignored with a checkpoint)
+    "decode": None,  # autoregressive decode block (tpuddp/serving/decode/):
+    # None -> request-granularity CNN serving only; a dict (or true for all
+    # defaults) arms the token-level engine — see DECODE_DEFAULTS. Same
+    # unknown-key-refusal contract as every other block.
 }
+
+
+# Autoregressive decode knobs (tpuddp/serving/decode/) — the
+# ``serving.decode`` block, consumed by ``python -m tpuddp.serving --decode``
+# and ``tools/loadgen.py --decode``. Same unknown-key-refusal contract.
+DECODE_DEFAULTS = {
+    "model": "transformer_tiny",  # model-zoo name; must be a TransformerLM
+    # family member (prefill/decode_step protocol, tpuddp/models/transformer.py)
+    "vocab_size": 256,  # token id space (the model's num_classes)
+    "checkpoint_dir": None,  # restore params via the integrity path (the
+    # request-granularity engine's contract); None -> fresh seeded init
+    "checkpoint_prefix": "auto",
+    "num_replicas": 1,  # independent decode replicas, each with its own KV
+    # pool + slot set + loop; "auto" -> every local device
+    "max_slots": 8,  # the fixed decode batch width: EVERY decode step runs
+    # the one compiled (max_slots, 1) program — sequences join/leave slots
+    # per step, the shape never changes, compile storms are structurally
+    # impossible on the decode path
+    "kv_blocks": 64,  # KV-pool blocks per replica (block 0 is the reserved
+    # garbage block, so kv_blocks - 1 are allocatable)
+    "kv_block_size": 16,  # tokens per KV block
+    "max_seq_len": 128,  # prompt + generated ceiling per sequence (also the
+    # position-embedding table length the model must cover)
+    "max_new_tokens": 32,  # per-request generation cap (requests may ask
+    # for fewer, never more)
+    "stop_token": None,  # token id that terminates a sequence when sampled
+    # (consumed, not emitted); None -> max_new_tokens is the only terminator
+    "temperature": 0.0,  # 0 = greedy argmax; > 0 = softmax sampling with a
+    # per-sequence deterministic stream (batch composition cannot change it)
+    "max_queue_depth": 256,  # admission control, as the outer serving block
+    "per_tenant_quota": None,
+    "stats_window": 64,  # generated tokens per decode_stats history row
+    "seed": 0,  # fresh-init parameter seed (ignored with a checkpoint)
+}
+
+
+def decode_config(serving: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Resolve a resolved serving block's ``decode`` sub-block: ``None``/
+    ``False`` -> None (no decode engine), ``True`` -> all defaults, a dict
+    -> defaults + overrides with unknown-key refusal."""
+    block = serving.get("decode")
+    if block is None or block is False:
+        return None
+    if block is True:
+        return dict(DECODE_DEFAULTS)
+    if not isinstance(block, dict):
+        raise ValueError(
+            f"serving.decode must be a mapping or bool, got {block!r}"
+        )
+    return _merge_refusing_unknown(DECODE_DEFAULTS, block, "serving.decode")
 
 
 # Live telemetry plane knobs (tpuddp/observability/{exporter,aggregate,

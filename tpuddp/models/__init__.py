@@ -6,6 +6,7 @@ CIFAR-stem/space-to-depth variants; all torch-importable."""
 
 from tpuddp.models.toy import ToyCNN, ToyMLP  # noqa: F401
 from tpuddp.models.alexnet import AlexNet  # noqa: F401
+from tpuddp.models.transformer import TransformerLM  # noqa: F401
 from tpuddp.models.resnet import (  # noqa: F401
     ResNet18, ResNet34, ResNet50, ResNet101, ResNet152,
 )
@@ -32,6 +33,15 @@ _REGISTRY = {
     "resnet50_small": _partial(ResNet50, small_input=True),
     "resnet101_small": _partial(ResNet101, small_input=True),
     "resnet152_small": _partial(ResNet152, small_input=True),
+    # decoder-only transformer family (tpuddp/models/transformer.py):
+    # num_classes aliases vocab_size; partition rules follow SNIPPETS.md
+    # [2]'s table so these drop into the future ("data","model") mesh
+    "transformer_tiny": _partial(
+        TransformerLM, d_model=64, n_heads=4, n_layers=2, max_seq_len=128,
+    ),
+    "transformer_small": _partial(
+        TransformerLM, d_model=128, n_heads=8, n_layers=4, max_seq_len=256,
+    ),
     # exact space-to-depth stem reparameterization (same params/checkpoints;
     # faster MXU mapping for the thin-channel strided stems)
     "alexnet_s2d": _partial(AlexNet, space_to_depth=True),
@@ -55,6 +65,7 @@ def load_model(name: str = "alexnet", num_classes: int = 10, **kwargs):
 __all__ = [
     "ToyMLP", "ToyCNN", "AlexNet", "ResNet18", "ResNet34", "ResNet50",
     "ResNet101", "ResNet152",
+    "TransformerLM",
     "VGG11", "VGG13", "VGG16", "VGG19",
     "load_model",
 ]

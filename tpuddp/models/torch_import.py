@@ -222,6 +222,33 @@ def _checked(tag: str, new: Dict, expect) -> Dict:
     return new
 
 
+def _recording(state_dict: Mapping[str, object]):
+    """Wrap a ``state_dict`` so every key READ is recorded; returns
+    ``(mapping, consumed_set)``. Together with :func:`_check_leftover` this
+    enforces strictness in the checkpoint->model direction: a converter
+    must touch every checkpoint tensor or the import is refused."""
+    consumed: set = set()
+
+    class _Recording(dict):
+        def __getitem__(self, k):
+            consumed.add(k)
+            return dict.__getitem__(self, k)
+
+    return _Recording(state_dict), consumed
+
+
+def _check_leftover(state_dict, consumed, layout: str) -> None:
+    leftover = sorted(
+        k for k in state_dict
+        if k not in consumed and not k.endswith("num_batches_tracked")
+    )
+    if leftover:
+        raise ValueError(
+            f"checkpoint has {len(leftover)} tensors this {layout} layout "
+            f"does not consume (e.g. {leftover[:3]}); wrong architecture?"
+        )
+
+
 def _convert_resnet_state_dict(
     state_dict: Mapping[str, object], params, model_state, depths, n_convs: int
 ):
@@ -234,17 +261,7 @@ def _convert_resnet_state_dict(
     which must ride along for eval-mode parity. Strictness both ways: every
     tensor the model expects must be in the checkpoint, and every checkpoint
     tensor must be consumed."""
-    consumed: set = set()
-
-    class _Recording(dict):
-        def __getitem__(self, k):
-            consumed.add(k)
-            return dict.__getitem__(self, k)
-
-        def __contains__(self, k):
-            return dict.__contains__(self, k)
-
-    state_dict = _Recording(state_dict)
+    state_dict, consumed = _recording(state_dict)
     new_p, new_s = list(params), list(model_state)
     # stem: Sequential[0]=Conv2d(64,7,s2), [1]=BatchNorm ([2] ReLU, [3] MaxPool)
     new_p[0] = _checked("conv1", {"weight": _conv_w(state_dict, "conv1")}, new_p[0])
@@ -280,16 +297,9 @@ def _convert_resnet_state_dict(
     # Unconsumed tensors mean the checkpoint is a DIFFERENT architecture
     # whose early blocks happen to be shape-compatible (e.g. a ResNet-34
     # imported as ResNet-18 would silently drop half its blocks).
-    leftover = sorted(
-        k for k in state_dict
-        if k not in consumed and not k.endswith("num_batches_tracked")
+    _check_leftover(
+        state_dict, consumed, f"ResNet{depths} ({n_convs}-conv block)"
     )
-    if leftover:
-        raise ValueError(
-            f"checkpoint has {len(leftover)} tensors this ResNet{depths} "
-            f"({n_convs}-conv block) layout does not consume (e.g. "
-            f"{leftover[:3]}); wrong architecture?"
-        )
     return tuple(new_p), tuple(new_s)
 
 
@@ -414,6 +424,78 @@ def load_pretrained_vgg(
         convert=lambda sd, p, s: (convert_vgg_state_dict(name, sd, p), s),
         salt=0x9ea,
     )
+
+
+def convert_transformer_state_dict(state_dict: Mapping[str, object], params):
+    """torch decoder-only transformer ``state_dict`` -> tpuddp
+    :class:`~tpuddp.models.transformer.TransformerLM` params.
+
+    Expected torch naming (the layout the parity test's reference module
+    uses — plain Linears, not ``nn.MultiheadAttention``, so the math is
+    explicit): ``embed.weight``, ``pos.weight``, per block ``blocks.{i}.
+    {ln1,ln2}.{weight,bias}``, ``blocks.{i}.attn.{in_proj,out_proj}.
+    {weight,bias}``, ``blocks.{i}.mlp.{fc1,fc2}.{weight,bias}``, and
+    ``ln_f.{weight,bias}``. Linear weights transpose ``(out, in) -> (in,
+    out)``; the joined ``in_proj`` packs ``[q; k; v]`` row blocks exactly as
+    tpuddp's ``wqkv`` packs them column-wise, so the transpose alone aligns
+    the ``joined_kv`` axis. The LM head is TIED to ``embed.weight`` on both
+    sides — a checkpoint with a separate ``head.weight`` is a different
+    architecture and is rejected by the leftover check."""
+    state_dict, consumed = _recording(state_dict)
+
+    def _lin(key):
+        return {
+            "weight": jnp.asarray(_to_np(state_dict[f"{key}.weight"]).T),
+            "bias": jnp.asarray(_to_np(state_dict[f"{key}.bias"])),
+        }
+
+    def _ln(key):
+        return {
+            "scale": jnp.asarray(_to_np(state_dict[f"{key}.weight"])),
+            "bias": jnp.asarray(_to_np(state_dict[f"{key}.bias"])),
+        }
+
+    new = dict(params)
+    new["embed"] = _checked(
+        "embed",
+        {"weight": jnp.asarray(_to_np(state_dict["embed.weight"]))},
+        params["embed"],
+    )
+    new["pos"] = _checked(
+        "pos",
+        {"weight": jnp.asarray(_to_np(state_dict["pos.weight"]))},
+        params["pos"],
+    )
+    blocks = []
+    for i, expect in enumerate(params["blocks"]):
+        t = f"blocks.{i}"
+        in_proj = _lin(f"{t}.attn.in_proj")
+        out_proj = _lin(f"{t}.attn.out_proj")
+        fc1, fc2 = _lin(f"{t}.mlp.fc1"), _lin(f"{t}.mlp.fc2")
+        block = {
+            "ln1": _ln(f"{t}.ln1"),
+            "attn": {
+                "wqkv": in_proj["weight"],
+                "bqkv": in_proj["bias"],
+                "wo": out_proj["weight"],
+                "bo": out_proj["bias"],
+            },
+            "ln2": _ln(f"{t}.ln2"),
+            "mlp": {
+                "w1": fc1["weight"],
+                "b1": fc1["bias"],
+                "w2": fc2["weight"],
+                "b2": fc2["bias"],
+            },
+        }
+        blocks.append(_checked(t, block, expect))
+    new["blocks"] = tuple(blocks)
+    new["ln_f"] = _checked("ln_f", _ln("ln_f"), params["ln_f"])
+    _check_leftover(
+        state_dict, consumed,
+        f"{len(params['blocks'])}-block TransformerLM",
+    )
+    return new
 
 
 _PRETRAINED_LOADERS = {

@@ -1,0 +1,330 @@
+"""Decoder-only transformer family — the token-stream workload (ROADMAP
+open item 3) and the proving ground for the future ``("data", "model")``
+mesh (open item 1).
+
+Architecture: learned token + position embeddings, pre-LayerNorm blocks of
+causal multi-head attention (joined QKV projection) and a GELU MLP, a final
+LayerNorm, and an LM head **tied** to the token embedding (logits =
+h @ embed.T — no separate head matrix, the GPT-2 convention).
+
+Every parameter carries *logical axis names* following exactly the rule
+table of SNIPPETS.md [2] (``heads``/``mlp``/``joined_kv`` -> the "model"
+mesh axis; ``batch``/``embed``/``kv``/``seq`` unsharded), exposed through
+:func:`param_logical_axes` / :func:`partition_spec` so the family drops into
+a 2-D ``("data", "model")`` mesh unchanged once the mesh work lands: the
+tensor-parallel split is already declared, only the ``with_sharding_
+constraint`` plumbing is missing.
+
+Three entry points share one set of per-block math helpers, so the
+full-sequence forward and the serving decode path cannot drift apart:
+
+- ``apply(params, state, tokens, ctx)``    — full causal forward, ``(B, T)``
+  int tokens -> ``(B, T, V)`` logits (training / eval / zoo protocol);
+- ``prefill(params, kpool, vpool, table_row, tokens, length)`` — one
+  prompt's forward at a padded length bucket, committing its K/V into the
+  paged pool and returning the last real position's logits (the first
+  sampled token — TTFT's clock stops here);
+- ``decode_step(params, kpool, vpool, tables, lengths, tokens)`` — the
+  fixed-shape ``(max_slots, 1)`` token step: one new token per slot, K/V
+  read/written through per-slot block tables (tpuddp/serving/decode/).
+
+Per-slot decode math depends only on that slot's own token, length, block
+table, and pool blocks — never on which other sequences share the batch —
+which is what makes continuous batching numerically invisible (the
+end-to-end acceptance test asserts bitwise-identical tokens vs a
+single-sequence decode).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpuddp import nn
+from tpuddp.nn.core import Context, Module
+
+# SNIPPETS.md [2]'s DEFAULT_RULES, with its "mp" axis spelled "model" (the
+# mesh axis name of ROADMAP open item 1): which mesh axis each LOGICAL
+# parameter axis shards over. None = replicated / data-sharded only.
+PARTITION_RULES = {
+    "batch": None,
+    "heads": "model",
+    "embed": None,
+    "mlp": "model",
+    "joined_kv": "model",
+    "kv": None,
+    "seq": None,
+    "vocab": None,
+}
+
+_NEG_INF = -1e30  # masked-score fill: finite, so fully-padded rows stay NaN-free
+
+
+def _uniform(key, shape, fan_in, dtype):
+    bound = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+class TransformerLM(Module):
+    """Decoder-only LM. ``num_classes`` aliases ``vocab_size`` so the model
+    zoo's ``load_model(name, num_classes=...)`` protocol applies unchanged
+    (the label space of a token model IS its vocabulary)."""
+
+    def __init__(
+        self,
+        num_classes: int = 256,
+        d_model: int = 64,
+        n_heads: int = 4,
+        n_layers: int = 2,
+        d_mlp: Optional[int] = None,
+        max_seq_len: int = 128,
+        dtype=jnp.float32,
+    ):
+        if d_model % n_heads:
+            raise ValueError(
+                f"d_model={d_model} not divisible by n_heads={n_heads}"
+            )
+        self.vocab_size = int(num_classes)
+        self.d_model = int(d_model)
+        self.n_heads = int(n_heads)
+        self.n_layers = int(n_layers)
+        self.d_mlp = int(d_mlp) if d_mlp is not None else 4 * self.d_model
+        self.max_seq_len = int(max_seq_len)
+        self.head_dim = self.d_model // self.n_heads
+        self.dtype = dtype
+        self._ln = nn.LayerNorm(dtype=dtype)
+
+    # ------------------------------------------------------------------ init --
+    def init(self, key, x):
+        E, H, Dh, F, V = (
+            self.d_model, self.n_heads, self.head_dim, self.d_mlp,
+            self.vocab_size,
+        )
+        k_embed, k_pos, k_blocks = jax.random.split(key, 3)
+        ln = {
+            "scale": jnp.ones((E,), self.dtype),
+            "bias": jnp.zeros((E,), self.dtype),
+        }
+        blocks = []
+        for i in range(self.n_layers):
+            kq, ko, k1, k2 = jax.random.split(jax.random.fold_in(k_blocks, i), 4)
+            blocks.append({
+                "ln1": dict(ln),
+                "attn": {
+                    "wqkv": _uniform(kq, (E, 3 * H * Dh), E, self.dtype),
+                    "bqkv": jnp.zeros((3 * H * Dh,), self.dtype),
+                    "wo": _uniform(ko, (H * Dh, E), H * Dh, self.dtype),
+                    "bo": jnp.zeros((E,), self.dtype),
+                },
+                "ln2": dict(ln),
+                "mlp": {
+                    "w1": _uniform(k1, (E, F), E, self.dtype),
+                    "b1": jnp.zeros((F,), self.dtype),
+                    "w2": _uniform(k2, (F, E), F, self.dtype),
+                    "b2": jnp.zeros((E,), self.dtype),
+                },
+            })
+        params = {
+            # N(0, 0.02): the GPT-2 embedding scale — fan-in uniform would
+            # start the tied head's logits far too hot at vocab scale
+            "embed": {
+                "weight": 0.02 * jax.random.normal(
+                    k_embed, (V, E), self.dtype
+                )
+            },
+            "pos": {
+                "weight": 0.02 * jax.random.normal(
+                    k_pos, (self.max_seq_len, E), self.dtype
+                )
+            },
+            "blocks": tuple(blocks),
+            "ln_f": dict(ln),
+        }
+        return params, ()
+
+    def divergent_state(self) -> bool:
+        return False  # parameters only, no buffers
+
+    # ----------------------------------------------------------- block math --
+    def _norm(self, p, x):
+        y, _ = self._ln.apply(p, (), x, Context(train=False))
+        return y
+
+    def _qkv(self, p, a):
+        """``a (..., E) -> q, k, v (..., H, Dh)`` through the joined
+        projection (the ``joined_kv`` logical axis)."""
+        qkv = a @ p["wqkv"] + p["bqkv"]
+        qkv = qkv.reshape(a.shape[:-1] + (3, self.n_heads, self.head_dim))
+        return qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+
+    def _attn_out(self, p, o):
+        """``o (..., H, Dh) -> (..., E)`` through the output projection."""
+        return o.reshape(o.shape[:-2] + (-1,)) @ p["wo"] + p["bo"]
+
+    def _mlp(self, p, a):
+        # exact (erf) GELU — torch nn.GELU's default, so imported torch
+        # checkpoints reproduce logits without an activation mismatch
+        return jax.nn.gelu(a @ p["w1"] + p["b1"], approximate=False) @ p["w2"] + p["b2"]
+
+    def _block_full(self, p, h, mask):
+        """One pre-LN block over a full ``(B, T, E)`` sequence; returns the
+        new hidden plus this layer's K/V ``(B, T, H, Dh)`` (the prefill
+        path's cache feed)."""
+        a = self._norm(p["ln1"], h)
+        q, k, v = self._qkv(p["attn"], a)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(self.head_dim)
+        scores = jnp.where(mask[None, None, :, :], scores, _NEG_INF)
+        attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(h.dtype)
+        h = h + self._attn_out(p["attn"], jnp.einsum("bhqk,bkhd->bqhd", attn, v))
+        return h + self._mlp(p["mlp"], self._norm(p["ln2"], h)), (k, v)
+
+    # ---------------------------------------------------------- full forward --
+    def apply(self, params, state, x, ctx: Context):
+        tokens = jnp.asarray(x).astype(jnp.int32)
+        B, T = tokens.shape
+        if T > self.max_seq_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_seq_len={self.max_seq_len}"
+            )
+        h = (
+            jnp.take(params["embed"]["weight"], tokens, axis=0)
+            + params["pos"]["weight"][:T]
+        )
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        for p in params["blocks"]:
+            h, _ = self._block_full(p, h, mask)
+        h = self._norm(params["ln_f"], h)
+        return h @ params["embed"]["weight"].T, state
+
+    # -------------------------------------------------------------- serving --
+    def prefill(self, params, kpool, vpool, table_row, tokens, length):
+        """One prompt's bucketed forward + paged-pool commit.
+
+        ``tokens``: ``(1, P)`` int32, the prompt zero-padded to bucket ``P``;
+        ``length``: the true prompt length (static-shape-safe scalar);
+        ``table_row``: ``(max_blocks,)`` int32 pool-block ids for this
+        sequence (0 = the reserved garbage block). Positions ``p < length``
+        scatter their K/V to ``(table_row[p // BS], p % BS)``; pad positions
+        are redirected into garbage block 0, so the pool write is one
+        fixed-shape scatter per layer. Returns ``(last_logits (V,), kpool,
+        vpool)`` — the logits of position ``length - 1``, i.e. the
+        distribution of the first generated token."""
+        P = tokens.shape[1]
+        BS = kpool.shape[2]
+        pos = jnp.arange(P)
+        live = pos < length
+        dest_blk = jnp.where(live, jnp.take(table_row, pos // BS), 0)
+        dest_off = pos % BS
+        h = (
+            jnp.take(params["embed"]["weight"], tokens.astype(jnp.int32), axis=0)
+            + params["pos"]["weight"][:P]
+        )
+        mask = jnp.tril(jnp.ones((P, P), bool))
+        for li, p in enumerate(params["blocks"]):
+            h, (k, v) = self._block_full(p, h, mask)
+            kpool = kpool.at[li, dest_blk, dest_off].set(k[0])
+            vpool = vpool.at[li, dest_blk, dest_off].set(v[0])
+        h_last = jnp.take(h[0], length - 1, axis=0)
+        h_last = self._norm(params["ln_f"], h_last)
+        return h_last @ params["embed"]["weight"].T, kpool, vpool
+
+    def decode_step(self, params, kpool, vpool, tables, lengths, tokens):
+        """The fixed-shape ``(max_slots, 1)`` token step.
+
+        ``tokens (S,)``: each slot's last sampled token; ``lengths (S,)``:
+        tokens already committed per slot (= the new token's position);
+        ``tables (S, MB)``: per-slot block tables. Every slot writes its new
+        K/V at ``(table[length // BS], length % BS)`` (inactive slots carry
+        all-zero tables and length 0, so their writes land in garbage block
+        0), attends over positions ``0..length`` inclusive, and returns its
+        next-token logits. One compiled program regardless of which
+        sequences occupy which slots."""
+        S, MB = tables.shape
+        BS = kpool.shape[2]
+        ctx_pos = jnp.arange(MB * BS)
+        x = (
+            jnp.take(params["embed"]["weight"], tokens.astype(jnp.int32), axis=0)
+            + jnp.take(params["pos"]["weight"], lengths, axis=0)
+        )
+        blk = jnp.take_along_axis(tables, (lengths // BS)[:, None], axis=1)[:, 0]
+        off = lengths % BS
+        mask = ctx_pos[None, :] <= lengths[:, None]  # (S, MB*BS)
+        for li, p in enumerate(params["blocks"]):
+            a = self._norm(p["ln1"], x)
+            q, k, v = self._qkv(p["attn"], a)  # (S, H, Dh)
+            kpool = kpool.at[li, blk, off].set(k)
+            vpool = vpool.at[li, blk, off].set(v)
+            # gather each slot's context through its block table; positions
+            # past the slot's length read stale/garbage blocks and are masked
+            kctx = jnp.take(kpool[li], tables, axis=0).reshape(
+                S, MB * BS, self.n_heads, self.head_dim
+            )
+            vctx = jnp.take(vpool[li], tables, axis=0).reshape(
+                S, MB * BS, self.n_heads, self.head_dim
+            )
+            scores = jnp.einsum("shd,skhd->shk", q, kctx) / math.sqrt(self.head_dim)
+            scores = jnp.where(mask[:, None, :], scores, _NEG_INF)
+            attn = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+            x = x + self._attn_out(p["attn"], jnp.einsum("shk,skhd->shd", attn, vctx))
+            x = x + self._mlp(p["mlp"], self._norm(p["ln2"], x))
+        x = self._norm(params["ln_f"], x)
+        return x @ params["embed"]["weight"].T, kpool, vpool
+
+
+# ----------------------------------------------------- partition metadata --
+
+
+def param_logical_axes(model: TransformerLM, params) -> dict:
+    """A pytree congruent with ``params`` whose leaves are tuples of LOGICAL
+    axis names (the vocabulary of :data:`PARTITION_RULES` / snippet [2])."""
+    ln = {"scale": ("embed",), "bias": ("embed",)}
+    block = {
+        "ln1": dict(ln),
+        "attn": {
+            "wqkv": ("embed", "joined_kv"),
+            "bqkv": ("joined_kv",),
+            "wo": ("heads", "embed"),
+            "bo": ("embed",),
+        },
+        "ln2": dict(ln),
+        "mlp": {
+            "w1": ("embed", "mlp"),
+            "b1": ("mlp",),
+            "w2": ("mlp", "embed"),
+            "b2": ("embed",),
+        },
+    }
+    return {
+        "embed": {"weight": ("vocab", "embed")},
+        "pos": {"weight": ("seq", "embed")},
+        "blocks": tuple(dict(block) for _ in params["blocks"]),
+        "ln_f": dict(ln),
+    }
+
+
+def partition_spec(model: TransformerLM, params, rules=None) -> dict:
+    """Map every parameter's logical axes through the rule table to MESH axis
+    names: the pytree a 2-D ``("data", "model")`` mesh feeds straight into
+    ``NamedSharding``/``with_sharding_constraint`` — e.g. ``wqkv`` ->
+    ``(None, "model")`` (column-split joined QKV), ``w2`` -> ``("model",
+    None)`` (row-split MLP contraction)."""
+    rules = dict(PARTITION_RULES if rules is None else rules)
+    axes = param_logical_axes(model, params)
+    return jax.tree_util.tree_map(
+        lambda names: tuple(rules[n] for n in names),
+        axes,
+        is_leaf=lambda leaf: isinstance(leaf, tuple)
+        and all(isinstance(n, str) for n in leaf),
+    )
+
+
+def prefill_buckets(max_prompt_len: int):
+    """The power-of-two prompt-length ladder: at most ``log2(max) + 1``
+    compiled prefill programs (the serving scheduler's bucket invariant,
+    tpuddp/utils/batching.bucket_sizes, applied to the sequence axis)."""
+    from tpuddp.utils import batching
+
+    return batching.bucket_sizes(max_prompt_len)

@@ -13,12 +13,17 @@ from tpuddp.nn.layers import (  # noqa: F401
     Conv2d,
     SpaceToDepthConv2d,
     Dropout,
+    Embedding,
     Flatten,
     Linear,
     MaxPool2d,
     ReLU,
 )
-from tpuddp.nn.norm import BatchNorm, convert_sync_batchnorm  # noqa: F401
+from tpuddp.nn.norm import (  # noqa: F401
+    BatchNorm,
+    LayerNorm,
+    convert_sync_batchnorm,
+)
 from tpuddp.nn.loss import CrossEntropyLoss, cross_entropy  # noqa: F401
 
 __all__ = [
@@ -33,8 +38,10 @@ __all__ = [
     "AdaptiveAvgPool2d",
     "ReLU",
     "Dropout",
+    "Embedding",
     "Flatten",
     "BatchNorm",
+    "LayerNorm",
     "convert_sync_batchnorm",
     "CrossEntropyLoss",
     "cross_entropy",

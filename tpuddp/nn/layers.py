@@ -59,6 +59,33 @@ class Linear(Module):
         return False  # parameters only, no buffers
 
 
+class Embedding(Module):
+    """Token-id lookup table: ``x`` int32 ids of any shape -> ``(*x.shape,
+    features)`` rows of ``weight``. torch ``nn.Embedding`` parity: N(0, 1)
+    init. The transformer LM head ties to this table (logits = h @ W.T), so
+    the weight layout is ``(num_embeddings, features)`` exactly like torch."""
+
+    def __init__(self, num_embeddings: int, features: int, dtype=jnp.float32):
+        self.num_embeddings = num_embeddings
+        self.features = features
+        self.dtype = dtype
+
+    def init(self, key, x):
+        params = {
+            "weight": jax.random.normal(
+                key, (self.num_embeddings, self.features), self.dtype
+            )
+        }
+        return params, ()
+
+    def apply(self, params, state, x, ctx: Context):
+        ids = jnp.asarray(x).astype(jnp.int32)
+        return jnp.take(params["weight"], ids, axis=0), state
+
+    def divergent_state(self) -> bool:
+        return False  # parameters only, no buffers
+
+
 class Conv2d(Module):
     """2-D convolution, NHWC / HWIO. ``padding`` is 'SAME', 'VALID', or an int
     (symmetric, torch-style)."""

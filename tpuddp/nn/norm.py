@@ -159,6 +159,46 @@ class BatchNorm(Module):
         return self.track_running_stats and not self.sync
 
 
+class LayerNorm(Module):
+    """Layer normalization over the last (feature) axis — the transformer
+    family's norm (tpuddp/models/transformer.py). Per-sample statistics, so
+    unlike :class:`BatchNorm` there are no running buffers, nothing diverges
+    across replicas, and train/eval are the same math.
+
+    torch parity: ``nn.LayerNorm(features)`` defaults — eps 1e-5, elementwise
+    affine, biased variance. Statistics accumulate in f32 even for bf16
+    activations (the BatchNorm convention above)."""
+
+    def __init__(self, eps: float = 1e-5, affine: bool = True, dtype=jnp.float32):
+        self.eps = eps
+        self.affine = affine
+        self.dtype = dtype
+
+    def init(self, key, x):
+        features = x.shape[-1]
+        params = (
+            {
+                "scale": jnp.ones((features,), self.dtype),
+                "bias": jnp.zeros((features,), self.dtype),
+            }
+            if self.affine
+            else {}
+        )
+        return params, ()
+
+    def apply(self, params, state, x, ctx: Context):
+        xs = x.astype(self.dtype)
+        mean = jnp.mean(xs, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xs - mean), axis=-1, keepdims=True)  # biased
+        y = (xs - mean) * lax.rsqrt(var + self.eps)
+        if self.affine:
+            y = y * params["scale"] + params["bias"]
+        return y.astype(x.dtype), state
+
+    def divergent_state(self) -> bool:
+        return False  # parameters only, no buffers
+
+
 def has_divergent_buffers(module: Module) -> bool:
     """True when the module tree contains a buffer that *diverges across
     replicas* under data parallelism. Used by the DDP step builder to refuse

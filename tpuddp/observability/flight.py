@@ -53,7 +53,7 @@ logger = logging.getLogger("tpuddp")
 DEFAULT_CAPACITY = 64
 
 # record types with their own ring; anything else (run_meta) is kept whole
-_RING_TYPES = ("step_stats", "event", "epoch", "serving_stats")
+_RING_TYPES = ("step_stats", "event", "epoch", "serving_stats", "decode_stats")
 
 _registry_lock = threading.Lock()
 _registry: List["FlightRecorder"] = []

@@ -35,8 +35,14 @@ pod-aggregation / flight-recorder provenance — a reader of a v5 history can
 tell whether a missing ``straggler`` event means "no straggler" or
 "aggregation was off") plus the ``straggler`` typed event and the
 ``flight_recording`` sidecar artifact (``flightrec_<reason>.json``,
-:func:`validate_flight_payload`). Readers accept every version up to their
-own ``SCHEMA_VERSION`` and reject newer files; the per-version
+:func:`validate_flight_payload`); v6 added the ``decode_stats`` record (the
+autoregressive decode engine's token-level SLO window —
+tpuddp/serving/decode/: tokens/sec, time-to-first-token, inter-token
+latency percentiles, KV-cache occupancy) and the required run_meta
+``decode`` provenance field (null = not a decode run; a decode header
+carries the KV-pool geometry, so a reader can tell "no decode windows"
+from "this was never a decode engine"). Readers accept every version up to
+their own ``SCHEMA_VERSION`` and reject newer files; the per-version
 required-field sets apply at the version each record CARRIES, so a v2
 history (no occupancy fields) stays valid under a v5 reader.
 """
@@ -48,9 +54,12 @@ import hashlib
 import json
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
-RECORD_TYPES = ("run_meta", "epoch", "step_stats", "event", "serving_stats")
+RECORD_TYPES = (
+    "run_meta", "epoch", "step_stats", "event", "serving_stats",
+    "decode_stats",
+)
 
 # Required keys per record type (beyond the envelope's type/schema_version).
 # Values may be null where a metric can legitimately blow up (strict-JSON
@@ -105,6 +114,25 @@ _REQUIRED = {
         "throughput_rps",
         "batch_occupancy",
     ),
+    # one row per decode-engine reporting window (tpuddp/serving/decode/):
+    # token-granularity throughput + the two latencies token traffic lives
+    # by (TTFT, ITL) + the KV-pool pressure gauge. Percentiles may be null
+    # in a window that completed zero tokens of its kind (e.g. a drain
+    # flush), never absent.
+    "decode_stats": (
+        "window",
+        "tokens",
+        "completed",
+        "rejected",
+        "tokens_per_sec",
+        "ttft_ms_p50",
+        "ttft_ms_p95",
+        "itl_ms_p50",
+        "itl_ms_p95",
+        "itl_ms_p99",
+        "kv_occupancy",
+        "active_sequences",
+    ),
 }
 
 # Fields additionally required of records stamped at schema_version >= N:
@@ -129,6 +157,13 @@ _REQUIRED_SINCE = {
     # events because all hosts were uniform" from "aggregation never ran".
     5: {
         "run_meta": ("observability",),
+    },
+    # v6: the decode engine's provenance. Null for every non-decode writer
+    # (training, request-granularity serving), but the KEY must exist — a
+    # reader needs to distinguish "no decode_stats windows because nothing
+    # decoded" from "this header predates the decode subsystem".
+    6: {
+        "run_meta": ("decode",),
     },
 }
 
@@ -160,6 +195,7 @@ def make_run_meta(
     comm_topology: Optional[str] = None,
     guard=None,
     observability: Optional[dict] = None,
+    decode: Optional[dict] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Build the run_meta header row from live run objects.
@@ -205,6 +241,9 @@ def make_run_meta(
         # exporter endpoint (bound port), pod aggregation + straggler knobs,
         # flight recorder (null = the whole plane off, e.g. minimal headers)
         "observability": observability,
+        # required since schema v6: the decode engine's provenance (model,
+        # slot width, KV-pool geometry; null = not an autoregressive run)
+        "decode": decode,
     }
     if extra:
         record.update(extra)
@@ -296,7 +335,10 @@ def validate_history_file(path: str) -> Tuple[List[str], int]:
 
 # Bench artifact (bench_results.json) — a single JSON object, not JSONL.
 _BENCH_REQUIRED = ("metric", "value", "unit", "vs_baseline", "device", "configs")
-_BENCH_ROW_REQUIRED = ("samples_per_sec_per_chip", "ms_per_step")
+_BENCH_ROW_REQUIRED = ("ms_per_step",)
+# every row must carry one RATE: samples/sec/chip (training + request
+# serving) or tokens/sec (autoregressive decode curves, loadgen --decode)
+_BENCH_ROW_RATES = ("samples_per_sec_per_chip", "tokens_per_sec")
 
 
 def validate_bench_payload(payload) -> List[str]:
@@ -315,6 +357,10 @@ def validate_bench_payload(payload) -> List[str]:
         missing = [k for k in _BENCH_ROW_REQUIRED if k not in row]
         if missing:
             errors.append(f"config {name!r}: missing field(s) {missing}")
+        if not any(k in row for k in _BENCH_ROW_RATES):
+            errors.append(
+                f"config {name!r}: needs one of {_BENCH_ROW_RATES}"
+            )
     return errors
 
 
@@ -353,7 +399,7 @@ _FLIGHT_REQUIRED = (
     "counts",
     "records",
 )
-_FLIGHT_RINGS = ("step_stats", "event", "epoch", "serving_stats")
+_FLIGHT_RINGS = ("step_stats", "event", "epoch", "serving_stats", "decode_stats")
 
 
 def validate_flight_payload(payload) -> List[str]:

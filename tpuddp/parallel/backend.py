@@ -108,19 +108,23 @@ def setup(
         num_processes is not None and num_processes > 1
     )
     if multihost:
-        from tpuddp.resilience import retry as _retry
+        # import the submodule directly: the package __init__ re-exports the
+        # retry FUNCTION under the same name, so `from tpuddp.resilience
+        # import retry` binds the callable, not the module
+        from tpuddp.resilience.retry import RetryPolicy as _RetryPolicy
+        from tpuddp.resilience.retry import retry as _retry
 
         # The rendezvous is the classic transient failure: N hosts race to
         # come up and the coordinator may not be listening yet. Jittered
         # backoff (3 attempts) decorrelates the herd; the terminal RetryError
         # names the coordinator so the failure is actionable.
-        _retry.retry(
+        _retry(
             lambda: jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id,
             ),
-            _retry.RetryPolicy(max_attempts=3, base_delay=2.0, max_delay=15.0),
+            _RetryPolicy(max_attempts=3, base_delay=2.0, max_delay=15.0),
             describe=(
                 f"jax.distributed.initialize (coordinator "
                 f"{coordinator_address or 'auto-discovered'})"

@@ -24,12 +24,26 @@ lockstep program:
   one dispatch loop per replica and a drain path reusing the resilience
   exit-code contract (SIGTERM -> finish in-flight work -> exit 75).
 
+Token traffic has its own sub-package: :mod:`tpuddp.serving.decode` is the
+autoregressive engine — paged KV-cache pool, continuous batching at TOKEN
+granularity (sequences join/leave the running batch every decode step),
+prefill/decode split scheduling, and per-token streaming — over the
+transformer family of ``tpuddp/models/transformer.py``.
+
 ``python -m tpuddp.serving --settings <yaml>`` stands the engine up from a
-settings file's ``serving`` block; ``tools/loadgen.py`` drives it with
+settings file's ``serving`` block (``--decode`` for the token engine from
+its ``serving.decode`` block); ``tools/loadgen.py`` drives it with
 closed/open-loop load and writes latency-vs-throughput curves in the bench
-artifact format.
+artifact format (``--decode`` for tokens/sec + TTFT curves).
 """
 
+from tpuddp.serving.decode import (  # noqa: F401
+    DecodeEngine,
+    DecodeRequest,
+    DecodeStats,
+    PagedKVCache,
+    StreamedResult,
+)
 from tpuddp.serving.engine import ServingEngine  # noqa: F401
 from tpuddp.serving.queue import (  # noqa: F401
     AdmissionError,
@@ -45,6 +59,11 @@ __all__ = [
     "AdmissionError",
     "Batch",
     "BatchScheduler",
+    "DecodeEngine",
+    "DecodeRequest",
+    "DecodeStats",
+    "PagedKVCache",
+    "StreamedResult",
     "Replica",
     "ReplicaPool",
     "Request",

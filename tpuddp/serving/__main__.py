@@ -11,6 +11,13 @@ Modes:
   in-process, wait for every result, print the SLO summary, exit 0. The
   zero-dependency smoke proof (the gate's serving leg uses tools/loadgen.py
   for the real curves).
+- ``--decode``  — stand the TOKEN-level engine up instead
+  (tpuddp/serving/decode/, configured by the ``serving.decode`` block; the
+  settings file must carry one). Demo traffic becomes synthetic token
+  prompts; with ``--serve`` the demo prompts are submitted WITHOUT waiting,
+  so a SIGTERM lands mid-decode and the drain must let every in-flight
+  sequence finish streaming before exit 75 — the gate's decode-drain leg
+  asserts exactly that.
 - ``--serve S`` — serve until SIGTERM/SIGINT or S seconds (0 = forever).
   SIGTERM drains: admission closes (new submits rejected with reason
   "draining"), in-flight and queued work completes, stats flush, and the
@@ -39,6 +46,20 @@ from tpuddp import config as config_lib
 from tpuddp.observability import json_sanitize
 from tpuddp.resilience import preemption
 from tpuddp.serving.engine import ServingEngine
+
+
+def _demo_prompts(engine, n: int, tenants: int, seed: int = 0):
+    """N variable-length synthetic token prompts round-robin over tenants;
+    returns the streaming results in submission order (not waited)."""
+    rng = np.random.RandomState(seed)
+    max_prompt = min(16, engine.max_prompt_len)
+    results = []
+    for i in range(n):
+        prompt = rng.randint(
+            0, engine.vocab_size, size=int(rng.randint(1, max_prompt + 1))
+        ).astype(np.int32)
+        results.append(engine.submit(f"tenant{i % tenants}", prompt))
+    return results
 
 
 def _demo_requests(engine: ServingEngine, n: int, tenants: int, seed: int = 0):
@@ -72,6 +93,10 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--tenants", type=int, default=2, help="demo-mode tenant count",
     )
+    parser.add_argument(
+        "--decode", action="store_true",
+        help="token-level autoregressive engine (the serving.decode block)",
+    )
     args = parser.parse_args(argv)
     if args.demo is None and args.serve is None:
         parser.error("at least one of --demo N / --serve S is required")
@@ -83,21 +108,40 @@ def main(argv=None) -> int:
     if out_dir:
         out_dir = config_lib.prepare_out_dir(settings, args.settings)
 
-    engine = ServingEngine.from_config(
-        serving, out_dir=out_dir, observability=observability
-    )
+    if args.decode:
+        from tpuddp.serving.decode import DecodeEngine
+
+        decode_cfg = config_lib.decode_config(serving)
+        if decode_cfg is None:
+            parser.error("--decode needs a serving.decode block in the settings")
+        engine = DecodeEngine.from_config(
+            decode_cfg, out_dir=out_dir, observability=observability
+        )
+    else:
+        engine = ServingEngine.from_config(
+            serving, out_dir=out_dir, observability=observability
+        )
     engine.start()
 
     if args.demo is not None:
-        results = _demo_requests(engine, args.demo, max(1, args.tenants))
-        for r in results:
-            r.result(timeout=120)
+        if args.decode:
+            results = _demo_prompts(engine, args.demo, max(1, args.tenants))
+        else:
+            results = _demo_requests(engine, args.demo, max(1, args.tenants))
         if args.serve is None:
+            for r in results:
+                r.result(timeout=120)
             summary = engine.drain(reason="demo_complete")
             print(json.dumps(json_sanitize(summary), allow_nan=False))
             return 0
         # --demo + --serve: keep the warm, traffic-populated engine up for
-        # the serve window (the live-ops scrape target)
+        # the serve window (the live-ops scrape target). Decode demo traffic
+        # is deliberately NOT waited on — a SIGTERM in the serve window
+        # lands mid-decode, and the drain contract (in-flight sequences
+        # finish streaming) is what the gate's drain leg verifies.
+        if not args.decode:
+            for r in results:
+                r.result(timeout=120)
         print("demo traffic complete; serving", flush=True)
 
     # --serve: SIGTERM/SIGINT -> resilience drain contract (exit 75)

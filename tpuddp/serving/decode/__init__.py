@@ -1,0 +1,42 @@
+"""tpuddp.serving.decode — token-level autoregressive serving.
+
+The request-granularity engine (tpuddp/serving/engine.py) serves CNN-style
+one-shot forwards; real "millions of users" traffic is token streams
+(ROADMAP open item 3). This package decodes them:
+
+- :mod:`cache`  — the paged KV-cache pool: one device-resident
+  ``(layers, blocks, block_size, heads, head_dim)`` K/V pool per replica,
+  per-sequence fixed-size block tables, free-list allocation/free
+  accounting, and the occupancy gauge;
+- :mod:`stats`  — token-level SLO metrics (tokens/sec, time-to-first-token,
+  inter-token latency percentiles, KV occupancy) emitted as typed
+  ``decode_stats`` rows (schema v6) through ``tpuddp/observability``;
+- :mod:`engine` — :class:`DecodeEngine`: continuous batching at TOKEN
+  granularity (sequences join and leave the running batch every step),
+  prefill/decode split scheduling (bucketed prompt prefill + ONE
+  fixed-shape ``(max_slots, 1)`` step program), host-side deterministic
+  sampling, per-token streaming on :class:`StreamedResult`, and the drain
+  contract shared with the rest of the stack.
+
+The model side lives in ``tpuddp/models/transformer.py`` (the decoder-only
+family whose partition rules follow SNIPPETS.md [2]); the config side is
+the ``serving.decode`` block (tpuddp/config.py:DECODE_DEFAULTS).
+"""
+
+from tpuddp.serving.decode.cache import PagedKVCache  # noqa: F401
+from tpuddp.serving.decode.engine import (  # noqa: F401
+    DecodeEngine,
+    DecodeReplica,
+    DecodeRequest,
+    StreamedResult,
+)
+from tpuddp.serving.decode.stats import DecodeStats  # noqa: F401
+
+__all__ = [
+    "DecodeEngine",
+    "DecodeReplica",
+    "DecodeRequest",
+    "DecodeStats",
+    "PagedKVCache",
+    "StreamedResult",
+]

@@ -1,0 +1,706 @@
+"""Autoregressive decode engine — token-level continuous batching.
+
+The request-granularity engine (tpuddp/serving/engine.py) batches whole
+requests: a request joins a batch once and leaves when the batch returns.
+Token traffic inverts the granularity: a sequence occupies a *slot* in the
+running batch for its whole generation, and the batch's membership changes
+**every decode step** — a sequence that samples its stop token frees its KV
+blocks immediately and a queued request prefills into the vacated slot
+before the next step. Throughput never drains to zero waiting for the
+longest sequence of a "batch", because there is no such thing as a batch
+boundary.
+
+Two-program scheduling (the prefill/decode split):
+
+- **prefill** — one prompt at a time, padded to a power-of-two length
+  bucket (the serving coalescer's ladder applied to the sequence axis): at
+  most ``log2(max_prompt) + 1`` compiled prefill programs. The prompt's K/V
+  is committed into the paged pool and its last position's logits sample
+  the FIRST generated token — TTFT's clock stops here.
+- **decode** — ONE fixed-shape ``(max_slots, 1)`` program for every step,
+  whatever subset of slots is live: per-slot block tables and lengths are
+  ordinary int32 inputs, so sequences joining and leaving never change the
+  compiled shape. Compile storms are structurally impossible on the hot
+  path.
+
+Sampling runs on the host from the step's logits: greedy argmax, or
+temperature softmax drawn from a per-sequence deterministic stream (seeded
+by the request's seed and its own token index — never by batch
+composition). Combined with per-slot-independent device math, this makes
+continuous batching **numerically invisible**: a sequence decodes to
+bitwise-identical tokens whether it ran alone or packed among strangers —
+the end-to-end acceptance test's contract.
+
+Streaming: ``submit`` returns a :class:`StreamedResult`; every sampled
+token is delivered to it as generated (``for tok in result.stream():``),
+and ``result()`` still blocks for the full sequence (the ServedResult
+contract, so non-streaming callers and load generators work unchanged).
+
+Lifecycle mirrors the request engine: ``start()`` warms every program,
+``drain()`` closes admission and lets in-flight sequences finish, and the
+``python -m tpuddp.serving --decode`` entrypoint maps SIGTERM onto drain +
+exit 75 (the resilience contract).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import queue as queue_lib
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuddp.models import load_model
+from tpuddp.models.transformer import TransformerLM, prefill_buckets
+from tpuddp.observability import MetricsWriter, schema
+from tpuddp.serving import queue as queue_mod
+from tpuddp.serving.decode.cache import PagedKVCache
+from tpuddp.serving.decode.stats import DecodeStats
+from tpuddp.serving.queue import AdmissionError, RequestQueue, ServedResult
+from tpuddp.utils import batching
+
+logger = logging.getLogger("tpuddp")
+
+_ids = itertools.count()
+_STREAM_END = object()
+
+
+class StreamedResult(ServedResult):
+    """Future for one sequence's tokens, streamed as generated.
+
+    ``stream()`` yields ints the moment the decode loop samples them;
+    ``result(timeout)`` (inherited) blocks for the FULL sequence and returns
+    it as an int32 array. A failed sequence raises through both paths."""
+
+    def __init__(self):
+        super().__init__()
+        self._stream: "queue_lib.Queue" = queue_lib.Queue()
+        self.first_token_at: Optional[float] = None
+
+    def _deliver_token(self, token: int) -> None:
+        if self.first_token_at is None:
+            self.first_token_at = time.perf_counter()
+        self._stream.put(int(token))
+
+    def _deliver(self, value, error=None) -> None:
+        super()._deliver(value, error=error)
+        self._stream.put(_STREAM_END)
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield tokens as they are generated; raises the sequence's error
+        (or TimeoutError on a stalled stream, matching ``result()``'s
+        contract) instead of hanging."""
+        while True:
+            try:
+                item = self._stream.get(timeout=timeout)
+            except queue_lib.Empty:
+                raise TimeoutError(
+                    f"decode stream stalled: no token within {timeout}s"
+                ) from None
+            if item is _STREAM_END:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
+
+class DecodeRequest:
+    """One admitted decode request. Duck-types the queue's ``Request``
+    protocol (tenant / rows / key / id / t_enqueue) so :class:`RequestQueue`
+    admission, per-tenant lanes, and round-robin fairness apply unchanged —
+    every request is one row of the same key, so any group assembles."""
+
+    __slots__ = (
+        "id", "tenant", "tokens", "max_new_tokens", "temperature", "seed",
+        "stop_token", "rows", "key", "t_enqueue", "result",
+    )
+
+    def __init__(
+        self, tenant: str, tokens: np.ndarray, max_new_tokens: int,
+        temperature: float, seed: int, stop_token: Optional[int],
+    ):
+        self.id = next(_ids)
+        self.tenant = str(tenant)
+        self.tokens = tokens
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.stop_token = stop_token
+        self.rows = 1
+        self.key = ("decode",)
+        self.t_enqueue = time.perf_counter()
+        self.result = StreamedResult()
+
+    @property
+    def total_tokens(self) -> int:
+        """Worst-case lifetime length — the KV budget reserved up front."""
+        return len(self.tokens) + self.max_new_tokens
+
+
+class _Active:
+    """One sequence occupying a decode slot."""
+
+    __slots__ = ("req", "slot", "last_token", "n_generated", "out", "t_last")
+
+    def __init__(self, req: DecodeRequest, slot: int, first_token: int):
+        self.req = req
+        self.slot = slot
+        self.last_token = first_token
+        self.n_generated = 1
+        self.out = [first_token]
+        self.t_last = time.perf_counter()
+
+
+def _sample(logits_row: np.ndarray, temperature: float, seed: int, index: int) -> int:
+    """Host-side sampling. Greedy at temperature 0; otherwise softmax with a
+    stream keyed by (request seed, token index) ONLY — two decodes of the
+    same request sample identically whatever else shares their batch."""
+    if temperature <= 0.0:
+        return int(np.argmax(logits_row))
+    rng = np.random.RandomState((seed * 1000003 + index * 7919 + 0x5D) & 0x7FFFFFFF)
+    z = logits_row.astype(np.float64) / temperature
+    z -= z.max()
+    p = np.exp(z)
+    p /= p.sum()
+    return int(rng.choice(len(p), p=p))
+
+
+class DecodeReplica:
+    """One device's decode lane: committed params, the jitted prefill (one
+    program per prompt bucket) and fixed-shape step programs, and a private
+    :class:`PagedKVCache` + K/V pool pair."""
+
+    def __init__(self, index: int, device, model: TransformerLM, params, cfg: dict):
+        self.index = index
+        self.device = device
+        self.model = model
+        self.params = jax.device_put(params, device)
+        self.cache = PagedKVCache(
+            layers=model.n_layers,
+            heads=model.n_heads,
+            head_dim=model.head_dim,
+            num_blocks=int(cfg["kv_blocks"]),
+            block_size=int(cfg["kv_block_size"]),
+            max_slots=int(cfg["max_slots"]),
+            max_seq_len=int(cfg["max_seq_len"]),
+        )
+        shape = self.cache.pool_shape()
+        self.kpool = jax.device_put(jnp.zeros(shape, jnp.float32), device)
+        self.vpool = jax.device_put(jnp.zeros(shape, jnp.float32), device)
+        # the pools are threaded through and the old buffers donated (cache
+        # module doc): without donation every token step would COPY both
+        # full K/V pools — doubling cache memory and adding a pool-sized
+        # transfer per step. Args: (params, kpool, vpool, ...) -> donate 1, 2.
+        # (XLA:CPU ignores donation with a note; the TPU path is the point.)
+        self._prefill = jax.jit(model.prefill, donate_argnums=(1, 2))
+        self._step = jax.jit(model.decode_step, donate_argnums=(1, 2))
+        self.steps = 0
+
+    def warmup(self, buckets: List[int]) -> None:
+        """Compile every prefill bucket + the step program now. Warmup
+        traffic writes only into reserved garbage block 0 (all-zero table
+        rows), so the allocatable pool is untouched."""
+        zrow = jnp.zeros((self.cache.max_blocks,), jnp.int32)
+        for P in buckets:
+            toks = jnp.zeros((1, P), jnp.int32)
+            out, self.kpool, self.vpool = self._prefill(
+                self.params, self.kpool, self.vpool, zrow, toks,
+                jnp.asarray(1, jnp.int32),
+            )
+            jax.block_until_ready(out)
+        S = self.cache.max_slots
+        out, self.kpool, self.vpool = self._step(
+            self.params, self.kpool, self.vpool,
+            jnp.zeros((S, self.cache.max_blocks), jnp.int32),
+            jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
+        )
+        jax.block_until_ready(out)
+        self.steps = 0
+
+
+class DecodeEngine:
+    """Token-level continuous-batching engine over N decode replicas."""
+
+    def __init__(
+        self,
+        cfg: dict,
+        out_dir: Optional[str] = None,
+        devices=None,
+        observability: Optional[dict] = None,
+    ):
+        from tpuddp import config as cfg_lib
+        from tpuddp.observability import exporter as exp_lib
+        from tpuddp.observability import flight as flight_lib
+        from tpuddp.serving.replica import _restore_variables
+
+        self.cfg = dict(cfg)
+        self.vocab_size = int(cfg["vocab_size"])
+        self.max_seq_len = int(cfg["max_seq_len"])
+        self.max_new_tokens = int(cfg["max_new_tokens"])
+        self.max_prompt_len = self.max_seq_len - 1  # >= 1 generated token
+        self.stop_token = (
+            None if cfg.get("stop_token") is None else int(cfg["stop_token"])
+        )
+        self.temperature = float(cfg.get("temperature") or 0.0)
+        self.buckets = prefill_buckets(self.max_prompt_len)
+
+        model = load_model(str(cfg["model"]), num_classes=self.vocab_size)
+        if not isinstance(model, TransformerLM):
+            raise ValueError(
+                f"decode.model {cfg['model']!r} is not a TransformerLM — the "
+                "decode engine needs the prefill/decode_step protocol"
+            )
+        if model.max_seq_len < self.max_seq_len:
+            raise ValueError(
+                f"decode.max_seq_len={self.max_seq_len} exceeds the model's "
+                f"position table ({model.max_seq_len})"
+            )
+        self.model = model
+        sample = jnp.zeros((1, 2), jnp.int32)
+        params, model_state = model.init(
+            jax.random.key(int(cfg.get("seed") or 0)), sample
+        )
+        self.restored_epoch = None
+        if cfg.get("checkpoint_dir"):
+            params, model_state, self.restored_epoch = _restore_variables(
+                cfg["checkpoint_dir"],
+                str(cfg.get("checkpoint_prefix") or "auto"),
+                params,
+                model_state,
+            )
+
+        if devices is None:
+            devices = jax.local_devices()
+        n = cfg.get("num_replicas", 1)
+        n = len(devices) if n == "auto" else int(n)
+        if n < 1 or n > len(devices):
+            raise ValueError(
+                f"num_replicas={n} outside [1, {len(devices)} local devices]"
+            )
+        self.replicas = [
+            DecodeReplica(i, d, model, params, cfg)
+            for i, d in enumerate(devices[:n])
+        ]
+
+        quota = cfg.get("per_tenant_quota")
+        self.queue = RequestQueue(
+            int(cfg["max_queue_depth"]),
+            None if quota is None else int(quota),
+        )
+        self._obs_cfg = cfg_lib.resolve_observability(observability)
+        self.flight = None
+        if self._obs_cfg["flight_recorder"] and out_dir:
+            self.flight = flight_lib.install(flight_lib.FlightRecorder(
+                out_dir, capacity=int(self._obs_cfg["flight_capacity"]),
+            ))
+        self.writer = (
+            MetricsWriter(out_dir, flight=self.flight) if out_dir else None
+        )
+        self.stats = DecodeStats(
+            self.writer,
+            window=int(cfg["stats_window"]),
+            gauges=lambda: (self.kv_occupancy(), self.active_sequences()),
+        )
+        self.exporter = exp_lib.exporter_from_config(
+            self._obs_cfg, run_dir=out_dir
+        )
+        self._threads: List[threading.Thread] = []
+        self._active_counts = [0] * len(self.replicas)
+        self._started = False
+        self._drained = False
+        self._in_flight_at_drain: Optional[int] = None
+
+    @classmethod
+    def from_config(
+        cls, cfg: dict, out_dir: Optional[str] = None, devices=None,
+        observability: Optional[dict] = None,
+    ) -> "DecodeEngine":
+        """``cfg`` is a resolved ``serving.decode`` block
+        (tpuddp/config.py:DECODE_DEFAULTS / decode_config)."""
+        return cls(cfg, out_dir=out_dir, devices=devices,
+                   observability=observability)
+
+    # -------------------------------------------------------------- gauges --
+    def kv_occupancy(self) -> float:
+        return sum(r.cache.occupancy() for r in self.replicas) / len(self.replicas)
+
+    def active_sequences(self) -> int:
+        return sum(self._active_counts)
+
+    def decode_meta(self) -> dict:
+        """The run_meta ``decode`` provenance block (schema v6)."""
+        cfg = self.cfg
+        return {
+            "model": cfg["model"],
+            "vocab_size": self.vocab_size,
+            "num_replicas": len(self.replicas),
+            "max_slots": int(cfg["max_slots"]),
+            "kv_blocks": int(cfg["kv_blocks"]),
+            "kv_block_size": int(cfg["kv_block_size"]),
+            "max_seq_len": self.max_seq_len,
+            "max_new_tokens": self.max_new_tokens,
+            "stop_token": self.stop_token,
+            "temperature": self.temperature,
+            "prefill_buckets": list(self.buckets),
+        }
+
+    # ----------------------------------------------------------- lifecycle --
+    def start(self, warmup: bool = True) -> "DecodeEngine":
+        if self._started:
+            return self
+        if self.exporter is not None:
+            self.exporter.start()
+            self.exporter.register_source(
+                "decode", self.stats.export_source(engine=self)
+            )
+        if self.writer is not None:
+            self.writer.write(schema.make_run_meta(
+                world_size=len(self.replicas),
+                comm_hook=None,
+                guard=None,
+                observability={
+                    "exporter": (
+                        self.exporter.describe()
+                        if self.exporter is not None else False
+                    ),
+                    "aggregate": False,
+                    "flight_recorder": (
+                        self.flight.describe()
+                        if self.flight is not None else False
+                    ),
+                },
+                decode=self.decode_meta(),
+                extra={
+                    "api": "serving_decode",
+                    "model": self.cfg.get("model"),
+                    "num_replicas": len(self.replicas),
+                    "max_queue_depth": self.queue.max_depth,
+                    "per_tenant_quota": self.queue.per_tenant_quota,
+                    "buckets": list(self.buckets),
+                    "restored_epoch": self.restored_epoch,
+                    "checkpoint_dir": self.cfg.get("checkpoint_dir"),
+                    "config_hash": schema.config_hash(self.cfg or None),
+                },
+            ))
+        if warmup:
+            t0 = time.perf_counter()
+            for r in self.replicas:
+                r.warmup(self.buckets)
+            logger.info(
+                "decode: %d replica(s) warm over prefill buckets %s + the "
+                "(%d, 1) step in %.1fs",
+                len(self.replicas), self.buckets,
+                self.replicas[0].cache.max_slots, time.perf_counter() - t0,
+            )
+        self.stats.reset_clock()
+        for replica in self.replicas:
+            t = threading.Thread(
+                target=self._decode_loop,
+                args=(replica,),
+                name=f"tpuddp-decode-r{replica.index}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        self._started = True
+        return self
+
+    def drain(self, reason: str = "shutdown", timeout: Optional[float] = None) -> dict:
+        """Close admission, let in-flight sequences decode to termination,
+        flush stats. Idempotent; returns the final summary, which carries
+        ``in_flight_at_drain`` — the active + queued sequence count at the
+        FIRST drain call, so a drain test can prove the signal landed
+        mid-decode rather than against an already-idle engine."""
+        if self._in_flight_at_drain is None:
+            self._in_flight_at_drain = (
+                self.active_sequences() + self.queue.depth()
+            )
+        self.queue.close()
+        for t in self._threads:
+            t.join(timeout)
+        alive = [t.name for t in self._threads if t.is_alive()]
+        if alive:
+            logger.warning(
+                "decode: loop(s) %s still running after the drain timeout; "
+                "stats not finalized yet", alive,
+            )
+            return self._summary()
+        if not self._drained:
+            self._drained = True
+            self.stats.flush_window()
+            if self.writer is not None:
+                summary = self.stats.summary()
+                self.writer.write(schema.stamp("event", {
+                    "event": "decode_drain",
+                    "reason": reason,
+                    **{k: summary[k] for k in (
+                        "submitted", "completed", "tokens", "tokens_per_sec",
+                    )},
+                }))
+                self.writer.close()
+            if self.exporter is not None:
+                self.exporter.stop()
+            if self.flight is not None:
+                from tpuddp.observability import flight as flight_lib
+
+                flight_lib.uninstall(self.flight)
+        return self._summary()
+
+    def _summary(self) -> dict:
+        out = self.stats.summary()
+        out["in_flight_at_drain"] = self._in_flight_at_drain
+        return out
+
+    # -------------------------------------------------------------- client --
+    def submit(
+        self,
+        tenant: str,
+        tokens,
+        max_new_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        seed: int = 0,
+        stop_token="default",
+    ) -> StreamedResult:
+        """Admit one prompt (1-D int token ids). Raises
+        :class:`AdmissionError` (bad_shape / oversized / queue_full /
+        tenant_quota / draining) or returns the streaming future."""
+        tokens = np.asarray(tokens)
+        self.stats.record_submit()
+        try:
+            if tokens.ndim != 1 or tokens.shape[0] < 1:
+                raise AdmissionError(
+                    queue_mod.REJECT_BAD_SHAPE,
+                    f"prompt must be a non-empty 1-D token vector, got shape "
+                    f"{tuple(tokens.shape)}",
+                )
+            if tokens.dtype.kind not in "iu":
+                raise AdmissionError(
+                    queue_mod.REJECT_BAD_SHAPE,
+                    f"prompt dtype {tokens.dtype} is not integer token ids",
+                )
+            if tokens.min() < 0 or tokens.max() >= self.vocab_size:
+                raise AdmissionError(
+                    queue_mod.REJECT_BAD_SHAPE,
+                    f"token ids outside [0, {self.vocab_size})",
+                )
+            if tokens.shape[0] > self.max_prompt_len:
+                raise AdmissionError(
+                    queue_mod.REJECT_OVERSIZED,
+                    f"{tokens.shape[0]}-token prompt > max_prompt_len="
+                    f"{self.max_prompt_len}",
+                )
+            mnt = self.max_new_tokens if max_new_tokens is None else int(max_new_tokens)
+            if mnt < 1 or mnt > self.max_new_tokens:
+                raise AdmissionError(
+                    queue_mod.REJECT_OVERSIZED,
+                    f"max_new_tokens={mnt} outside [1, {self.max_new_tokens}]",
+                )
+            if tokens.shape[0] + mnt > self.max_seq_len:
+                raise AdmissionError(
+                    queue_mod.REJECT_OVERSIZED,
+                    f"prompt ({tokens.shape[0]}) + max_new_tokens ({mnt}) > "
+                    f"max_seq_len={self.max_seq_len}",
+                )
+            request = DecodeRequest(
+                tenant,
+                np.array(tokens, dtype=np.int32, copy=True),  # own the prompt
+                mnt,
+                self.temperature if temperature is None else float(temperature),
+                seed,
+                self.stop_token if stop_token == "default" else stop_token,
+            )
+            self.queue.put(request)
+        except AdmissionError as e:
+            self.stats.record_reject(tenant, e.reason)
+            raise
+        return request.result
+
+    # ------------------------------------------------------------- decoding --
+    def _finish(self, cache: PagedKVCache, seq: _Active) -> None:
+        """Terminate one sequence: free its KV blocks (capacity visible to
+        the very next admission pass) and deliver the final array."""
+        cache.free(seq.slot)
+        seq.req.result._deliver(np.asarray(seq.out, np.int32))
+        self.stats.record_finish(seq.req.tenant)
+
+    def _prefill_one(
+        self, replica: DecodeReplica, slot: int, req: DecodeRequest
+    ) -> Optional[_Active]:
+        """Prefill one prompt into its slot and sample the first token.
+        Returns the active sequence, or None when it terminated at birth
+        (first sample hit the stop token, or max_new_tokens == 1)."""
+        cache = replica.cache
+        n = len(req.tokens)
+        P = batching.bucket_for(n, self.max_prompt_len)
+        buf = np.zeros((1, P), np.int32)
+        buf[0, :n] = req.tokens
+        logits, replica.kpool, replica.vpool = replica._prefill(
+            replica.params, replica.kpool, replica.vpool,
+            jnp.asarray(cache.tables[slot]), jnp.asarray(buf),
+            jnp.asarray(n, jnp.int32),
+        )
+        cache.lengths[slot] = n
+        tok = _sample(np.asarray(logits), req.temperature, req.seed, 0)
+        if req.stop_token is not None and tok == req.stop_token:
+            # terminated before emitting anything: an empty (but successful)
+            # stream — the stop token is consumed, never delivered
+            seq = _Active(req, slot, tok)
+            seq.out = []
+            self._finish(cache, seq)
+            return None
+        req.result._deliver_token(tok)
+        self.stats.record_first_token(
+            (time.perf_counter() - req.t_enqueue) * 1e3, n
+        )
+        seq = _Active(req, slot, tok)
+        if seq.n_generated >= req.max_new_tokens:
+            self._finish(cache, seq)
+            return None
+        return seq
+
+    def _recover_pools(
+        self, replica: DecodeReplica, active: Dict[int, "_Active"]
+    ) -> None:
+        """A dispatch that failed AFTER consuming its donated K/V pool
+        buffers (donate_argnums — real on an accelerator, ignored by
+        XLA:CPU) leaves ``replica.kpool/vpool`` bound to deleted arrays, so
+        every later prefill/step on the replica would raise forever. Probe
+        for that and rebuild from empty pools; any KV state the surviving
+        sequences held lived in the lost buffers, so they are failed too."""
+        try:
+            poisoned = (
+                replica.kpool.is_deleted() or replica.vpool.is_deleted()
+            )
+        except Exception:  # noqa: BLE001 — treat an unprobeable pool as lost
+            poisoned = True
+        if not poisoned:
+            return
+        cache = replica.cache
+        err = RuntimeError(
+            f"decode replica {replica.index}: KV pools consumed by a failed "
+            "donated dispatch; in-flight sequences reset"
+        )
+        for seq in list(active.values()):
+            cache.free(seq.slot)
+            seq.req.result._deliver(None, error=err)
+        active.clear()
+        self._active_counts[replica.index] = 0
+        shape = cache.pool_shape()
+        replica.kpool = jax.device_put(
+            jnp.zeros(shape, jnp.float32), replica.device
+        )
+        replica.vpool = jax.device_put(
+            jnp.zeros(shape, jnp.float32), replica.device
+        )
+        logger.warning(
+            "decode: replica %d KV pools rebuilt after a failed donated "
+            "dispatch", replica.index,
+        )
+
+    def _decode_loop(self, replica: DecodeReplica) -> None:
+        """One replica's life: admit -> prefill -> step -> deliver -> retire,
+        every iteration. Exits when the queue closes and drains AND every
+        in-flight sequence has terminated (the drain contract: SIGTERM never
+        truncates a stream). A failed prefill rejects only its own request;
+        a failed step fails the sequences that were in flight on this
+        replica (their streams raise), frees their slots, and the loop keeps
+        serving — the request engine's failure-isolation contract."""
+        cache = replica.cache
+        pending: List[DecodeRequest] = []
+        active: Dict[int, _Active] = {}
+        S = cache.max_slots
+        while True:
+            # -- admit: pull queued requests round-robin into free capacity.
+            # Capacity counts BLOCKS as well as slots, at worst-case lifetime
+            # budget (max_blocks per sequence): a block-starved replica must
+            # not pull work into its private pending list that an idle
+            # sibling could place immediately — requests it cannot yet hold
+            # stay in the shared queue where any replica can take them.
+            capacity = min(
+                cache.free_slots, cache.free_blocks // cache.max_blocks
+            )
+            if not active and not pending:
+                group = self.queue.take_group(max(1, capacity), wait=True)
+                if group is None:
+                    return
+            else:
+                room = capacity - len(pending)
+                group = (
+                    self.queue.take_group(room, wait=False) if room > 0 else []
+                )
+                group = group or []  # None (closed) -> finish what we hold
+            pending.extend(group)
+            # -- place: strict arrival order; stop at the first request the
+            # pool cannot hold yet, so nobody is starved by a smaller
+            # latecomer jumping the block queue
+            while pending and cache.can_admit(pending[0].total_tokens):
+                req = pending.pop(0)
+                slot = cache.allocate(req.total_tokens)
+                try:
+                    seq = self._prefill_one(replica, slot, req)
+                except BaseException as e:  # noqa: BLE001 — delivered to the client
+                    logger.exception(
+                        "decode: prefill failed on replica %d", replica.index
+                    )
+                    cache.free(slot)
+                    req.result._deliver(None, error=e)
+                    self._recover_pools(replica, active)
+                    continue
+                if seq is not None:
+                    active[seq.slot] = seq
+            self._active_counts[replica.index] = len(active)
+            if not active:
+                if pending or not self.queue.closed:
+                    continue
+                if self.queue.depth() == 0:
+                    return
+                continue
+            # -- step: the one fixed-shape (max_slots, 1) program
+            tokens = np.zeros((S,), np.int32)
+            for slot, seq in active.items():
+                tokens[slot] = seq.last_token
+            try:
+                logits, replica.kpool, replica.vpool = replica._step(
+                    replica.params, replica.kpool, replica.vpool,
+                    jnp.asarray(cache.tables), jnp.asarray(cache.lengths),
+                    jnp.asarray(tokens),
+                )
+                logits = np.asarray(logits)  # fetch = fence
+            except BaseException as e:  # noqa: BLE001
+                logger.exception(
+                    "decode: step failed on replica %d", replica.index
+                )
+                for seq in list(active.values()):
+                    cache.free(seq.slot)
+                    seq.req.result._deliver(None, error=e)
+                active.clear()
+                self._active_counts[replica.index] = 0
+                self._recover_pools(replica, active)
+                continue
+            replica.steps += 1
+            now = time.perf_counter()
+            for slot, seq in list(active.items()):
+                cache.lengths[slot] += 1  # the step committed last_token's KV
+                tok = _sample(
+                    logits[slot], seq.req.temperature, seq.req.seed,
+                    seq.n_generated,
+                )
+                if seq.req.stop_token is not None and tok == seq.req.stop_token:
+                    del active[slot]
+                    self._finish(cache, seq)
+                    continue
+                seq.out.append(tok)
+                seq.n_generated += 1
+                seq.req.result._deliver_token(tok)
+                self.stats.record_token((now - seq.t_last) * 1e3)
+                seq.t_last = now
+                seq.last_token = tok
+                if seq.n_generated >= seq.req.max_new_tokens:
+                    del active[slot]
+                    self._finish(cache, seq)
+            self._active_counts[replica.index] = len(active)

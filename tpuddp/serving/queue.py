@@ -247,14 +247,19 @@ class RequestQueue:
         return taken, key
 
     def take_group(
-        self, max_rows: int, top_up_wait: float = 0.0
+        self, max_rows: int, top_up_wait: float = 0.0, wait: bool = True
     ) -> Optional[List[Request]]:
         """Block for work, then assemble one same-key group (see class doc).
-        ``None`` = closed and fully drained."""
+        ``None`` = closed and fully drained. ``wait=False`` never blocks:
+        an open-but-empty queue returns ``[]`` — the decode loop's
+        between-steps poll (it must keep stepping its active sequences, not
+        sleep on the condition variable, while the queue is empty)."""
         with self._cond:
             while self._depth == 0:
                 if self._closed:
                     return None
+                if not wait:
+                    return []
                 self._cond.wait()
             taken, key = self._assemble(max_rows)
             if not taken:
