@@ -281,15 +281,21 @@ def test_drain_then_submit_rejected(cpu_devices):
     assert eng.drain()["completed"] == 1
 
 
-def test_failed_dispatch_with_consumed_pools_recovers(cpu_devices):
+def test_failed_dispatch_with_consumed_pools_fails_over(cpu_devices):
     """A dispatch that raises after consuming its donated K/V pool buffers
     (real donation semantics on an accelerator; XLA:CPU ignores donation,
     so the injected failure deletes the arrays itself) must not poison the
-    replica: the in-flight batch fails, the pools are rebuilt, and the
-    next request decodes normally on the same replica."""
+    replica OR kill its streams: under the survivability layer
+    (tpuddp/serving/survive.py) the in-flight sequence parks into its
+    session journal, the replica rebuilds through probation, and the
+    stream completes BITWISE-equal to an undisturbed same-seed run."""
     eng = DecodeEngine.from_config(_decode_cfg(), devices=cpu_devices)
     eng.start()
     try:
+        rng = np.random.RandomState(13)
+        p = _prompt(rng)
+        # undisturbed twin first, so the failover run has a bitwise anchor
+        twin = np.asarray(eng.submit("t", p, seed=3).result(timeout=120))
         replica = eng.replicas[0]
         real_step = replica._step
         fired = threading.Event()
@@ -303,14 +309,12 @@ def test_failed_dispatch_with_consumed_pools_recovers(cpu_devices):
             return real_step(params, kpool, vpool, *rest)
 
         replica._step = consuming_step
-        rng = np.random.RandomState(13)
-        p = _prompt(rng)
-        with pytest.raises(RuntimeError):
-            eng.submit("t", p, seed=3).result(timeout=120)
-        assert fired.is_set()
         out = np.asarray(eng.submit("t", p, seed=3).result(timeout=120))
-        assert out.ndim == 1 and out.size > 0
+        assert fired.is_set()
+        np.testing.assert_array_equal(out, twin)
         assert not replica.kpool.is_deleted()
+        assert replica.recoveries == 1 and replica.healthy
+        assert eng.stats.failovers == 1
     finally:
         eng.drain()
 
@@ -333,7 +337,9 @@ def test_decode_stats_rows_and_run_meta_validate(tmp_path, cpu_devices):
     assert errors == [] and n >= 3
     records = [json.loads(l) for l in open(history) if l.strip()]
     meta = records[0]
-    assert meta["type"] == "run_meta" and meta["schema_version"] == 6
+    assert meta["type"] == "run_meta" and meta["schema_version"] == 7
+    # v7: the survivability provenance is non-null on decode headers
+    assert meta["survivability"]["max_recoveries"] == 2
     dec = meta["decode"]
     assert dec["model"] == "transformer_tiny"
     assert dec["max_slots"] == 4 and dec["kv_block_size"] == 8
@@ -353,12 +359,21 @@ def test_decode_stats_schema_reject_drift():
         "ttft_ms_p50": 1.0, "ttft_ms_p95": 2.0,
         "itl_ms_p50": 0.5, "itl_ms_p95": 0.9, "itl_ms_p99": 1.1,
         "kv_occupancy": 0.25, "active_sequences": 2,
+        "shed": 0, "failovers": 0,
     })
     assert schema.validate_record(good) == []
     bad = dict(good)
     del bad["tokens_per_sec"], bad["kv_occupancy"]
     errs = schema.validate_record(bad)
     assert any("tokens_per_sec" in e and "kv_occupancy" in e for e in errs)
+    # v7 drift: a window without its survivability accounting is invalid —
+    # but a v6 copy without them stays valid (versioned requirement)
+    drifted = {k: v for k, v in good.items() if k not in ("shed", "failovers")}
+    errs = schema.validate_record(drifted)
+    assert errs and any("shed" in e and "failovers" in e for e in errs)
+    v6 = dict(drifted)
+    v6["schema_version"] = 6
+    assert schema.validate_record(v6) == []
 
 
 def test_v6_run_meta_requires_decode_provenance(tmp_path):

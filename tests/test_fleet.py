@@ -204,11 +204,12 @@ def test_plan_keep_action_when_nothing_changes():
 
 
 # -------------------------------------------------------------- autoscaler --
-def OBS(p99=None, occ=None, stragglers=None, cursor=0):
+def OBS(p99=None, occ=None, stragglers=None, shed=None, cursor=0):
     return {
         "p99_ms": p99,
         "occupancy": occ,
         "straggler_events": stragglers,
+        "shed_total": shed,
         "fresh_cursor": cursor,
     }
 
@@ -222,6 +223,8 @@ def test_autoscale_policy_validation():
         AutoscalePolicy(scale_down_below=1.0)
     with pytest.raises(ValueError):
         AutoscalePolicy(shrink_factor=1)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(shed_high=0)
 
 
 def test_autoscale_serving_scale_up_needs_hysteresis_of_fresh_windows():
@@ -270,6 +273,55 @@ def test_autoscale_occupancy_breach_also_scales_up():
     a = Autoscaler(AutoscalePolicy(occupancy_high=0.9, hysteresis=1,
                                    cooldown_s=0.0))
     assert a.propose("s", "serving", 1, 1, 4, OBS(occ=0.97, cursor=1), 0.0) == 2
+
+
+def test_autoscale_shed_rate_breach_scales_up_with_hysteresis():
+    """The survivability rule (schema v7): >= shed_high NEWLY shed requests
+    per fresh window is overload evidence — sustained for the hysteresis,
+    it scales serving up even with p99/occupancy silent."""
+    a = Autoscaler(AutoscalePolicy(shed_high=2, hysteresis=2, cooldown_s=0.0))
+    # first observation is the baseline counter — never a breach, whatever
+    # the cumulative total already is
+    assert a.propose("s", "serving", 1, 1, 4, OBS(shed=10, cursor=1), 0.0) is None
+    # +3 shed in a fresh window: breach 1 of 2
+    assert a.propose("s", "serving", 1, 1, 4, OBS(shed=13, cursor=2), 1.0) is None
+    # +3 again: hysteresis met -> scale up
+    assert a.propose("s", "serving", 1, 1, 4, OBS(shed=16, cursor=3), 2.0) == 2
+    assert a.actions[-1]["action"] == "scale_up"
+    assert "shed" in a.actions[-1]["why"]
+
+
+def test_autoscale_shed_stale_window_is_not_evidence():
+    """A re-scraped window (cursor unmoved) must not extend the shed streak
+    — and the baseline only advances on FRESH windows, so the deferred
+    delta still convicts once the engine makes progress."""
+    a = Autoscaler(AutoscalePolicy(shed_high=2, hysteresis=1, cooldown_s=0.0))
+    assert a.propose("s", "serving", 1, 1, 4, OBS(shed=10, cursor=1), 0.0) is None
+    # shed_total climbed but the window is STALE: no action, baseline held
+    assert a.propose("s", "serving", 1, 1, 4, OBS(shed=20, cursor=1), 1.0) is None
+    # the same total on a fresh window: delta +10 vs the held baseline
+    assert a.propose("s", "serving", 1, 1, 4, OBS(shed=20, cursor=2), 2.0) == 2
+
+
+def test_autoscale_shed_below_threshold_never_acts():
+    a = Autoscaler(AutoscalePolicy(shed_high=5, hysteresis=1, cooldown_s=0.0))
+    assert a.propose("s", "serving", 1, 1, 4, OBS(shed=0, cursor=1), 0.0) is None
+    for i in range(2, 6):  # +1 shed per window, under the threshold
+        assert a.propose(
+            "s", "serving", 1, 1, 4, OBS(shed=i - 1, cursor=i), float(i)
+        ) is None
+    assert a.actions == []
+
+
+def test_autoscale_shed_rule_disabled_without_knob():
+    # shed evidence flows through the observation, but shed_high=None
+    # (the default) never arms the rule
+    a = Autoscaler(AutoscalePolicy(slo_p99_ms=100.0, hysteresis=1,
+                                   cooldown_s=0.0))
+    assert a.propose("s", "serving", 1, 1, 4, OBS(p99=5, shed=0, cursor=1), 0.0) is None
+    assert a.propose("s", "serving", 1, 1, 4,
+                     OBS(p99=5, shed=1000, cursor=2), 1.0) is None
+    assert a.actions == []
 
 
 def test_autoscale_training_shrinks_on_new_straggler_conviction():

@@ -479,9 +479,17 @@ def test_serving_stats_schema_reject_drift():
         "window": 0, "requests": 4, "completed": 4, "rejected": 0,
         "queue_ms_p50": 1.0, "device_ms_p50": 0.5, "e2e_ms_p50": 2.0,
         "e2e_ms_p95": 3.0, "e2e_ms_p99": 4.0, "throughput_rps": 10.0,
-        "batch_occupancy": 0.9,
+        "batch_occupancy": 0.9, "shed": 0,
     })
     assert schema.validate_record(good) == []
+    # v7 drift: a window without its shed count is invalid; a v6 copy
+    # without it stays valid (versioned requirement)
+    drifted = {k: v for k, v in good.items() if k != "shed"}
+    errs = schema.validate_record(drifted)
+    assert errs and any("shed" in e for e in errs)
+    v6 = dict(drifted)
+    v6["schema_version"] = 6
+    assert schema.validate_record(v6) == []
     missing = dict(good)
     del missing["e2e_ms_p99"]
     assert any("e2e_ms_p99" in e for e in schema.validate_record(missing))

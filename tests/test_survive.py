@@ -1,0 +1,929 @@
+"""Serving survivability suite (ISSUE 13, tpuddp/serving/survive.py).
+
+The headline contract: a decode replica that dies mid-stream loses ZERO
+streams — every live sequence parks into a host-side session journal,
+fails over (to a healthy peer, or to the same replica once it passes
+probation), and continues **bitwise-equal** to an undisturbed same-seed
+run. Around it: the replica probation state machine
+(rejoin / relapse / ``max_recoveries`` -> permanent removal), deadline
+load shedding (queued-expired work is never dispatched; in-flight work is
+never deadline-killed), per-tenant retry budgets for transient dispatch
+failures, the typed ``no_healthy_replica`` terminal outcome (never a
+hang), the ``$TPUDDP_FAULT`` serving kinds, and schema-v7 drift
+rejection.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpuddp import config as config_lib
+from tpuddp.observability import schema
+from tpuddp.resilience import faults
+from tpuddp.serving import (
+    AdmissionError,
+    NoHealthyReplicaError,
+    RetryBudget,
+    ServingEngine,
+    SurvivePolicy,
+)
+from tpuddp.serving import queue as queue_mod
+from tpuddp.serving import survive as survive_lib
+from tpuddp.serving.decode import DecodeEngine
+from tpuddp.serving.queue import Request, RequestQueue
+
+VOCAB = 32
+SHAPE = (4, 4, 1)
+
+
+def _decode_cfg(**overrides):
+    cfg = config_lib.decode_config({"decode": {}})
+    cfg.update(
+        model="transformer_tiny",
+        vocab_size=VOCAB,
+        num_replicas=1,
+        max_slots=4,
+        kv_blocks=17,  # 16 allocatable = exactly 4 worst-case sequences
+        kv_block_size=8,
+        max_seq_len=32,
+        max_new_tokens=8,
+        stats_window=16,
+        max_queue_depth=64,
+        recovery_backoff_s=0.01,
+    )
+    cfg.update(overrides)
+    return cfg
+
+
+def _serving_cfg(**overrides):
+    cfg = {
+        "model": "toy_mlp",
+        "num_classes": 10,
+        "input_shape": list(SHAPE),
+        "num_replicas": 1,
+        "max_batch_size": 8,
+        "max_queue_depth": 64,
+        "batch_timeout_ms": 0.0,
+        "stats_window": 16,
+        "recovery_backoff_s": 0.01,
+    }
+    cfg.update(overrides)
+    return config_lib._merge_refusing_unknown(
+        config_lib.SERVING_DEFAULTS, cfg, "serving"
+    )
+
+
+def _prompt(rng, n=None):
+    n = n if n is not None else int(rng.randint(1, 13))
+    return rng.randint(0, VOCAB, size=n).astype(np.int32)
+
+
+def _events(out_dir):
+    path = os.path.join(out_dir, "history.jsonl")
+    if not os.path.exists(path):
+        return []
+    return [
+        json.loads(line)
+        for line in open(path)
+        if line.strip() and json.loads(line).get("type") == "event"
+    ]
+
+
+# ------------------------------------------------------------------ policy --
+
+
+def test_survive_policy_validation_and_from_config():
+    with pytest.raises(ValueError):
+        SurvivePolicy(request_ttl_s=0)
+    with pytest.raises(ValueError):
+        SurvivePolicy(max_recoveries=-1)
+    with pytest.raises(ValueError):
+        SurvivePolicy(recovery_attempts=0)
+    with pytest.raises(ValueError):
+        SurvivePolicy(recovery_backoff_s=-0.1)
+    with pytest.raises(ValueError):
+        SurvivePolicy(retry_budget=-1)
+    with pytest.raises(ValueError):
+        SurvivePolicy(max_failovers=-1)
+    # stale config dicts (pre-survivability) resolve to the defaults
+    pol = SurvivePolicy.from_config({})
+    assert pol.request_ttl_s is None and pol.max_recoveries == 2
+    assert pol.max_failovers == 1
+    pol = SurvivePolicy.from_config(
+        {"request_ttl_s": 1.5, "max_recoveries": 0, "retry_budget": 3}
+    )
+    assert pol.request_ttl_s == 1.5 and pol.retry_budget == 3
+    meta = pol.meta()
+    assert meta["max_recoveries"] == 0 and meta["retry_budget"] == 3
+
+
+def test_admission_deadline_combinations():
+    assert survive_lib.admission_deadline(10.0, None, None) is None
+    assert survive_lib.admission_deadline(10.0, 5.0, None) == 15.0
+    assert survive_lib.admission_deadline(10.0, None, 2.0) == 12.0
+    # the TIGHTER of engine TTL and client deadline wins
+    assert survive_lib.admission_deadline(10.0, 5.0, 2.0) == 12.0
+    assert survive_lib.admission_deadline(10.0, 1.0, 2.0) == 11.0
+    with pytest.raises(ValueError):
+        survive_lib.admission_deadline(10.0, None, -1.0)
+
+
+def test_retry_budget_consume_refund_exhaustion():
+    b = RetryBudget(2)
+    assert b.try_consume("a") and b.try_consume("a")
+    assert not b.try_consume("a")  # exhausted
+    assert b.try_consume("b")  # per-tenant, not global
+    b.refund("a")
+    assert b.try_consume("a")
+    b.refund("a", n=10)  # over-refund clamps at zero used
+    assert b.used("a") == 0
+    # disabled budget never allows a retry
+    assert not RetryBudget(0).try_consume("a")
+
+
+def test_run_probation_attempts_and_backoff():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise RuntimeError("not yet")
+
+    pol = SurvivePolicy(recovery_attempts=3, recovery_backoff_s=0.0)
+    assert survive_lib.run_probation(
+        name="r0", recover=flaky, policy=pol, sleep=lambda s: None
+    )
+    assert len(calls) == 2
+    calls.clear()
+    assert not survive_lib.run_probation(
+        name="r0",
+        recover=lambda: (_ for _ in ()).throw(RuntimeError("dead")),
+        policy=pol,
+        sleep=lambda s: None,
+    )
+
+
+# ----------------------------------------------------------- queue shedding --
+
+
+def test_queue_sheds_expired_heads_not_journals():
+    q = RequestQueue(max_depth=16)
+    shed_seen = []
+    q.shed_handler = shed_seen.append
+    now = time.perf_counter()
+    expired = Request("a", np.zeros((1,) + SHAPE, np.float32), deadline=now - 1)
+    live = Request("a", np.zeros((1,) + SHAPE, np.float32), deadline=now + 60)
+    q.put(expired)
+    q.put(live)
+    group = q.take_group(8, wait=False)
+    assert [r.id for r in group] == [live.id]
+    assert shed_seen == [expired]
+    with pytest.raises(AdmissionError) as e:
+        expired.result.result(timeout=1)
+    assert e.value.reason == "deadline_exceeded"
+    assert live.result.done() is False
+    # a failover journal (resume_tokens set) is in-flight work: NEVER shed
+    q2 = RequestQueue(max_depth=16)
+    journal = Request("a", np.zeros((1,) + SHAPE, np.float32), deadline=now - 1)
+    journal.resume_tokens = [3, 4]  # duck-typed the decode way
+    q2.put(journal)
+    group = q2.take_group(8, wait=False)
+    assert [r.id for r in group] == [journal.id]
+
+
+def test_queue_all_expired_returns_empty_not_oversized_error():
+    q = RequestQueue(max_depth=16)
+    now = time.perf_counter()
+    for _ in range(3):
+        q.put(Request("a", np.zeros((1,) + SHAPE, np.float32), deadline=now - 1))
+    assert q.take_group(8, wait=False) == []
+    assert q.depth() == 0
+
+
+def test_queue_requeue_bypasses_closed_and_jumps_lane_front():
+    q = RequestQueue(max_depth=16)
+    a = Request("t", np.zeros((1,) + SHAPE, np.float32))
+    b = Request("t", np.zeros((1,) + SHAPE, np.float32))
+    q.put(a)
+    q.put(b)
+    q.close()
+    with pytest.raises(AdmissionError):
+        q.put(Request("t", np.zeros((1,) + SHAPE, np.float32)))
+    c = Request("t", np.zeros((1,) + SHAPE, np.float32))
+    q.requeue(c)  # already-admitted work re-enters even while draining
+    group = q.take_group(8, wait=False)
+    assert [r.id for r in group] == [c.id, a.id, b.id]
+    assert q.take_group(8) is None  # closed + drained
+
+
+# ------------------------------------------------------------- fault kinds --
+
+
+def test_fault_parse_serving_kinds_and_pairings():
+    specs = faults.parse_fault_specs(
+        "replica_kill@step=4,pool_poison@step=7,dispatch_wedge@batch=2,"
+        "replica_kill@batch=9"
+    )
+    assert [(s.kind, s.site, s.arg) for s in specs] == [
+        ("replica_kill", "step", "4"),
+        ("pool_poison", "step", "7"),
+        ("dispatch_wedge", "batch", "2"),
+        ("replica_kill", "batch", "9"),
+    ]
+    for bad in (
+        "pool_poison@batch=1",   # pools live on the decode step site
+        "replica_kill@epoch=1",  # serving kinds pair with dispatch sites
+        "nan@batch=1",           # training kind on the serving site
+        "hang@batch=1",
+    ):
+        with pytest.raises(ValueError):
+            faults.parse_fault_specs(bad)
+
+
+def test_serving_faults_invisible_to_training_hooks(monkeypatch):
+    monkeypatch.setenv("TPUDDP_FAULT", "replica_kill@step=1")
+    faults.reload_faults()
+    try:
+        # the trainer's per-batch hook must not arm, and maybe_fire must
+        # not consume the spec
+        assert not faults.has_step_fault()
+        faults.maybe_fire("step", step=1)
+        assert not faults.active_faults()[0].fired
+        # the serving hook consumes it exactly once
+        assert faults.maybe_serving_fault("step", step=1) == "replica_kill"
+        assert faults.maybe_serving_fault("step", step=1) is None
+    finally:
+        monkeypatch.delenv("TPUDDP_FAULT")
+        faults.reload_faults()
+
+
+# ----------------------------------------- decode failover (the headline) --
+
+
+def _one_shot_step_killer(replica, after=0, consume_pools=False):
+    """Patch ``replica._step`` to fail exactly once after ``after``
+    successful calls; later calls (and probation's canary) pass through."""
+    real_step = replica._step
+    state = {"calls": 0, "fired": False}
+
+    def step(params, kpool, vpool, *rest):
+        if not state["fired"] and state["calls"] >= after:
+            state["fired"] = True
+            if consume_pools:
+                kpool.delete()
+                vpool.delete()
+            raise RuntimeError("injected replica death")
+        state["calls"] += 1
+        return real_step(params, kpool, vpool, *rest)
+
+    replica._step = step
+    return state
+
+
+@pytest.mark.parametrize(
+    "prompt_lens,temperature,kill_after",
+    [
+        # mid-decode kill, bucket-interior prompts
+        ((3, 5, 12), 0.0, 2),
+        # prefill-bucket boundary prompts (ladder [1,2,4,8,16,31]): an
+        # exact bucket fit and the first length of the next bucket
+        ((8, 9), 0.0, 1),
+        # temperature sampling: the (seed, index) stream survives failover
+        ((4, 6), 0.9, 2),
+    ],
+)
+def test_failover_mid_decode_bitwise(tmp_path, cpu_devices, prompt_lens,
+                                     temperature, kill_after):
+    """THE acceptance matrix: kill the (only) replica mid-sweep — every
+    live stream parks, the replica passes probation, the sessions resume
+    on it, and every stream is BITWISE the undisturbed same-seed run."""
+    out = str(tmp_path / "run")
+    eng = DecodeEngine.from_config(
+        _decode_cfg(), out_dir=out, devices=cpu_devices
+    )
+    eng.start()
+    try:
+        rng = np.random.RandomState(0)
+        prompts = [_prompt(rng, n) for n in prompt_lens]
+        twins = [
+            np.asarray(
+                eng.submit("t", p, seed=7 + i, temperature=temperature)
+                .result(timeout=120)
+            )
+            for i, p in enumerate(prompts)
+        ]
+        state = _one_shot_step_killer(eng.replicas[0], after=kill_after)
+        results = [
+            eng.submit("t", p, seed=7 + i, temperature=temperature)
+            for i, p in enumerate(prompts)
+        ]
+        streamed = [list(r.stream(timeout=120)) for r in results]
+        assert state["fired"], "the injected death never fired"
+        for i, r in enumerate(results):
+            final = np.asarray(r.result(timeout=1))
+            np.testing.assert_array_equal(final, twins[i])
+            assert streamed[i] == list(twins[i])
+    finally:
+        summary = eng.drain()
+    # zero lost streams, one failover event per migrated sequence, the
+    # replica back in routing after probation
+    assert summary["completed"] == 2 * len(prompt_lens)
+    assert summary["failovers"] >= 1
+    events = _events(out)
+    failovers = [e for e in events if e["event"] == "session_failover"]
+    assert len(failovers) == summary["failovers"]
+    assert all(e["to_replica"] == 0 for e in failovers)
+    assert any(e["event"] == "replica_unhealthy" for e in events)
+    recovered = [e for e in events if e["event"] == "replica_recovered"]
+    assert recovered and recovered[0]["recoveries"] == 1
+    errors, _ = schema.validate_history_file(os.path.join(out, "history.jsonl"))
+    assert errors == []
+
+
+def test_failover_during_prefill_bitwise(cpu_devices):
+    """The replica dies DURING a prompt's prefill dispatch: the request
+    (a zero-token session) re-prefills after recovery and the whole stream
+    is bitwise the undisturbed run — token index 0 samples identically."""
+    eng = DecodeEngine.from_config(_decode_cfg(), devices=cpu_devices)
+    eng.start()
+    try:
+        rng = np.random.RandomState(1)
+        p = _prompt(rng, 5)
+        twin = np.asarray(eng.submit("t", p, seed=3).result(timeout=120))
+        replica = eng.replicas[0]
+        real_prefill = replica._prefill
+        state = {"fired": False}
+
+        def prefill(params, kpool, vpool, *rest):
+            if not state["fired"]:
+                state["fired"] = True
+                raise RuntimeError("injected prefill death")
+            return real_prefill(params, kpool, vpool, *rest)
+
+        replica._prefill = prefill
+        out = np.asarray(eng.submit("t", p, seed=3).result(timeout=120))
+        assert state["fired"]
+        np.testing.assert_array_equal(out, twin)
+        assert eng.stats.failovers == 1
+    finally:
+        eng.drain()
+
+
+def test_failover_spreads_to_surviving_replica(tmp_path, cpu_devices):
+    """Two replicas, both carrying live sessions; one dies mid-sweep. Every
+    stream completes bitwise (the dead replica's sessions migrate wherever
+    capacity lives) and the pool ends with both replicas healthy."""
+    out = str(tmp_path / "run")
+    eng = DecodeEngine.from_config(
+        _decode_cfg(num_replicas=2, max_slots=2, kv_blocks=9),
+        out_dir=out,
+        devices=cpu_devices,
+    )
+    eng.start()
+    try:
+        rng = np.random.RandomState(2)
+        prompts = [_prompt(rng) for _ in range(8)]
+        twins = [
+            np.asarray(eng.submit("t", p, seed=20 + i).result(timeout=120))
+            for i, p in enumerate(prompts)
+        ]
+        # kill replica 0 once it has stepped a few times (it holds live
+        # sessions by then; > slots requests keep both replicas busy)
+        state = _one_shot_step_killer(eng.replicas[0], after=2)
+        results = [
+            eng.submit("t", p, seed=20 + i) for i, p in enumerate(prompts)
+        ]
+        for i, r in enumerate(results):
+            np.testing.assert_array_equal(
+                np.asarray(r.result(timeout=120)), twins[i]
+            )
+        assert state["fired"]
+    finally:
+        summary = eng.drain()
+    assert summary["completed"] == 16
+    assert all(r.healthy for r in eng.replicas)
+    events = _events(out)
+    assert any(e["event"] == "session_failover" for e in events)
+    assert any(e["event"] == "replica_recovered" for e in events)
+
+
+def test_failover_via_fault_env_replica_kill(tmp_path, cpu_devices, monkeypatch):
+    """The $TPUDDP_FAULT contract end to end: replica_kill@step=N lands
+    mid-sweep through the decode loop's own injection site, and the
+    survivability layer turns it into zero lost streams + probation."""
+    out = str(tmp_path / "run")
+    eng = DecodeEngine.from_config(
+        _decode_cfg(), out_dir=out, devices=cpu_devices
+    )
+    eng.start()
+    try:
+        rng = np.random.RandomState(3)
+        prompts = [_prompt(rng, n) for n in (4, 7)]
+        twins = [
+            np.asarray(eng.submit("t", p, seed=40 + i).result(timeout=120))
+            for i, p in enumerate(prompts)
+        ]
+        steps_so_far = eng.replicas[0].steps
+        monkeypatch.setenv(
+            "TPUDDP_FAULT", f"replica_kill@step={steps_so_far + 3}"
+        )
+        faults.reload_faults()
+        results = [
+            eng.submit("t", p, seed=40 + i) for i, p in enumerate(prompts)
+        ]
+        for i, r in enumerate(results):
+            np.testing.assert_array_equal(
+                np.asarray(r.result(timeout=120)), twins[i]
+            )
+        assert all(s.fired for s in faults.active_faults())
+        assert eng.replicas[0].recoveries == 1
+        assert not eng.replicas[0].broken  # rebuild cleared the kill
+    finally:
+        monkeypatch.delenv("TPUDDP_FAULT")
+        faults.reload_faults()
+        eng.drain()
+    assert any(e["event"] == "session_failover" for e in _events(out))
+
+
+def test_pool_poison_fault_env_rebuilds_and_continues(cpu_devices, monkeypatch):
+    """pool_poison@step=N deletes the donated K/V pools mid-sweep (the real
+    accelerator donation death): sessions fail over, the pools are rebuilt,
+    the stream completes bitwise."""
+    eng = DecodeEngine.from_config(_decode_cfg(), devices=cpu_devices)
+    eng.start()
+    try:
+        rng = np.random.RandomState(4)
+        p = _prompt(rng, 6)
+        twin = np.asarray(eng.submit("t", p, seed=5).result(timeout=120))
+        steps_so_far = eng.replicas[0].steps
+        monkeypatch.setenv(
+            "TPUDDP_FAULT", f"pool_poison@step={steps_so_far + 2}"
+        )
+        faults.reload_faults()
+        out = np.asarray(eng.submit("t", p, seed=5).result(timeout=120))
+        np.testing.assert_array_equal(out, twin)
+        assert not eng.replicas[0].kpool.is_deleted()
+        assert eng.replicas[0].recoveries == 1
+    finally:
+        monkeypatch.delenv("TPUDDP_FAULT")
+        faults.reload_faults()
+        eng.drain()
+
+
+def test_poisoned_request_fails_through_pool_survives(cpu_devices):
+    """The poisoned-request firewall (max_failovers): a request whose OWN
+    content deterministically kills any prefill dispatch is parked once,
+    fails through with the dispatch error on the next incident, and the
+    replica — whose probation passes each time (the fault was the request,
+    not the device) — stays in routing for everyone else."""
+    eng = DecodeEngine.from_config(
+        _decode_cfg(max_recoveries=5), devices=cpu_devices
+    )
+    eng.start()
+    replica = eng.replicas[0]
+    rng = np.random.RandomState(8)
+    poison = _prompt(rng, 5)
+    real_prefill = replica._prefill
+
+    def poisoned_prefill(params, kpool, vpool, table, buf, n, *rest):
+        row = np.asarray(buf)[0]
+        if (int(n) == len(poison)
+                and np.array_equal(row[: len(poison)], poison)):
+            raise RuntimeError("this request kills the dispatch")
+        return real_prefill(params, kpool, vpool, table, buf, n, *rest)
+
+    replica._prefill = poisoned_prefill
+    try:
+        res = eng.submit("t", poison)
+        with pytest.raises(RuntimeError, match="kills the dispatch"):
+            res.result(timeout=120)
+        # the fail-through verdict is delivered BEFORE the second probation
+        # episode finishes — wait for the replica to rejoin routing
+        deadline = time.perf_counter() + 60
+        while not replica.healthy and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        assert replica.healthy
+        # request-attributed incidents whose canary passed never charge the
+        # replica's lifetime max_recoveries budget — the device was
+        # provably fine; the request's own failover budget bounded it
+        assert replica.recoveries == 0
+        # the pool still serves everyone else
+        clean = rng.randint(0, VOCAB, size=7).astype(np.int32)
+        out = np.asarray(eng.submit("t", clean).result(timeout=120))
+        assert out.ndim == 1 and out.size > 0
+    finally:
+        summary = eng.drain()
+    assert summary["completed"] == 1
+
+
+def test_poison_incidents_never_charge_innocent_sessions(cpu_devices):
+    """Attribution regression: repeated incidents CAUSED BY one poisoned
+    request must not spend innocent concurrent sessions' failover budgets
+    — the innocents park, migrate, and complete bitwise every time."""
+    eng = DecodeEngine.from_config(
+        _decode_cfg(max_new_tokens=16, max_seq_len=64, kv_blocks=33),
+        devices=cpu_devices,
+    )
+    eng.start()
+    replica = eng.replicas[0]
+    rng = np.random.RandomState(10)
+    innocent = _prompt(rng, 4)
+    poison = _prompt(rng, 6)
+    twin = np.asarray(
+        eng.submit("t", innocent, seed=5, max_new_tokens=16).result(timeout=120)
+    )
+    real_prefill = replica._prefill
+    real_step = replica._step
+
+    def poisoned_prefill(params, kpool, vpool, table, buf, n, *rest):
+        row = np.asarray(buf)[0]
+        if (int(n) == len(poison)
+                and np.array_equal(row[: len(poison)], poison)):
+            raise RuntimeError("this request kills the dispatch")
+        return real_prefill(params, kpool, vpool, table, buf, n, *rest)
+
+    def slow_step(*a, **k):
+        time.sleep(0.02)  # keep the innocent in flight across incidents
+        return real_step(*a, **k)
+
+    replica._prefill = poisoned_prefill
+    replica._step = slow_step
+    try:
+        live = eng.submit("t", innocent, seed=5, max_new_tokens=16)
+        assert next(live.stream(timeout=120)) is not None  # mid-decode
+        # two poisons -> up to four place-phase incidents, each parking the
+        # innocent; with default max_failovers=1 an unattributed charge
+        # would kill the innocent on the second incident
+        poisons = [eng.submit("t", poison), eng.submit("t", poison)]
+        for p in poisons:
+            with pytest.raises(RuntimeError, match="kills the dispatch"):
+                p.result(timeout=120)
+        out = np.asarray(live.result(timeout=120))
+        np.testing.assert_array_equal(out, twin)
+    finally:
+        summary = eng.drain()
+    assert summary["completed"] == 2  # the twin + the surviving innocent
+
+
+def test_last_replica_death_during_drain_fails_typed_not_hang(cpu_devices):
+    """Drain-window strand regression: with an idle peer's loop already
+    EXITED (queue closed and it saw nothing to do), the replica holding
+    the last live session dies persistently. Its journal must not be
+    handed to the dead peer's loop — no survivors means the typed
+    no_healthy_replica failure, promptly, never a hang."""
+    eng = DecodeEngine.from_config(
+        _decode_cfg(num_replicas=2, max_new_tokens=32, max_seq_len=64,
+                    kv_blocks=33),
+        devices=cpu_devices,
+    )
+    eng.start()
+    rng = np.random.RandomState(9)
+    armed = threading.Event()
+
+    def wrap(replica):
+        real_step = replica._step
+
+        def step(*a, **k):
+            if armed.is_set():
+                raise RuntimeError("device is gone")
+            time.sleep(0.01)  # keep the stream alive long enough to drain
+            return real_step(*a, **k)
+
+        replica._step = step
+
+    for r in eng.replicas:
+        wrap(r)
+    res = eng.submit("t", _prompt(rng, 3), max_new_tokens=32)
+    assert next(res.stream(timeout=120)) is not None  # live, mid-decode
+    # close admission: the IDLE replica's loop exits (drained from its
+    # view); the busy one keeps stepping its session
+    eng.queue.close()
+    deadline = time.perf_counter() + 60
+    while (sum(1 for r in eng.replicas if r.loop_alive) > 1
+           and time.perf_counter() < deadline):
+        time.sleep(0.01)
+    assert sum(1 for r in eng.replicas if r.loop_alive) == 1
+    armed.set()  # now the busy replica dies persistently (canary included)
+    with pytest.raises(NoHealthyReplicaError):
+        res.result(timeout=120)
+    summary = eng.drain()
+    assert summary["completed"] == 0
+
+
+# ------------------------------------------------- probation state machine --
+
+
+def test_decode_probation_relapse_then_max_recoveries_removal(
+    tmp_path, cpu_devices
+):
+    """Rejoin -> relapse -> rejoin -> the NEXT incident crosses
+    max_recoveries=2 and removes the replica permanently; as the last
+    replica, parked and queued work fails with the typed
+    no_healthy_replica reason — and nothing hangs."""
+    out = str(tmp_path / "run")
+    eng = DecodeEngine.from_config(
+        _decode_cfg(max_recoveries=2), out_dir=out, devices=cpu_devices
+    )
+    eng.start()
+    rng = np.random.RandomState(5)
+    replica = eng.replicas[0]
+    try:
+        for expected_recoveries in (1, 2):
+            _one_shot_step_killer(replica, after=1)
+            outv = np.asarray(eng.submit("t", _prompt(rng)).result(timeout=120))
+            assert outv.ndim == 1
+            assert replica.recoveries == expected_recoveries
+            assert replica.healthy
+        # third incident: probation budget spent -> removed, typed failure
+        _one_shot_step_killer(replica, after=1)
+        res = eng.submit("t", _prompt(rng))
+        with pytest.raises(NoHealthyReplicaError) as e:
+            res.result(timeout=120)
+        assert e.value.reason == "no_healthy_replica"
+        assert replica.state == "removed"
+        # new arrivals fail fast and typed too (mortuary, never a hang)
+        late = eng.submit("t", _prompt(rng))
+        with pytest.raises(NoHealthyReplicaError):
+            late.result(timeout=120)
+    finally:
+        eng.drain()  # returns — the mortuary loop exits on close + empty
+    events = _events(out)
+    recovered = [e for e in events if e["event"] == "replica_recovered"]
+    assert [e["recoveries"] for e in recovered] == [1, 2]
+    removed = [e for e in events if e["event"] == "replica_removed"]
+    assert removed and removed[0]["reason"] == "max_recoveries"
+    assert any(e["event"] == "no_healthy_replica" for e in events)
+    # a removed replica's stale cache is out of the occupancy gauge — the
+    # autoscaler must not see phantom KV pressure from a dead pool
+    assert eng.kv_occupancy() == 0.0
+    errors, _ = schema.validate_history_file(os.path.join(out, "history.jsonl"))
+    assert errors == []
+
+
+def test_decode_last_replica_persistent_death_one_recovery_round_then_typed(
+    cpu_devices,
+):
+    """The regression pair's second outcome: a PERSISTENTLY dead last
+    replica (probation's canary keeps failing) parks its sessions,
+    attempts one recovery round, and only then fails everything typed —
+    queued requests included, and drain still returns."""
+    eng = DecodeEngine.from_config(
+        _decode_cfg(max_slots=2, kv_blocks=9), devices=cpu_devices
+    )
+    eng.start()
+    rng = np.random.RandomState(6)
+    replica = eng.replicas[0]
+    attempts = {"n": 0}
+
+    def dead_step(*a, **k):
+        attempts["n"] += 1
+        raise RuntimeError("device is gone")
+
+    try:
+        in_flight = eng.submit("t", _prompt(rng), max_new_tokens=8)
+        assert in_flight.stream(timeout=120).__next__() is not None  # live
+        replica._step = dead_step
+        replica._prefill = dead_step
+        queued = [eng.submit("t", _prompt(rng)) for _ in range(3)]
+        for res in [in_flight] + queued:
+            with pytest.raises(NoHealthyReplicaError):
+                res.result(timeout=120)
+        # probation genuinely ran before the typed failure: the canary
+        # hit the dead dispatch at least recovery_attempts times
+        assert attempts["n"] >= eng.survive.recovery_attempts
+    finally:
+        summary = eng.drain()
+    assert summary["completed"] == 0
+    assert replica.state == "removed"
+
+
+def test_serving_replica_probation_rejoins_after_transient_errors(
+    tmp_path, cpu_devices
+):
+    """Request-granularity engine: K consecutive dispatch errors ->
+    probation -> the canary passes (the fault was transient) -> the replica
+    REJOINS routing instead of dying forever, with the event trail."""
+    eng = ServingEngine.from_config(
+        _serving_cfg(num_replicas=1, unhealthy_after=2),
+        out_dir=str(tmp_path),
+        devices=cpu_devices[:1],
+    )
+    eng.start()
+    replica = eng.pool.replicas[0]
+    real_infer = replica.infer
+    state = {"fails": 0}
+
+    def flaky_infer(x):
+        if state["fails"] < 2:
+            state["fails"] += 1
+            raise RuntimeError("transient device blip")
+        return real_infer(x)
+
+    replica.infer = flaky_infer
+    try:
+        # two sequential failures cross unhealthy_after=2 -> probation ->
+        # canary (3rd call) succeeds -> rejoin
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                eng.submit("t", np.zeros((1,) + SHAPE, np.float32)).result(
+                    timeout=60
+                )
+        ok = eng.submit("t", np.zeros((2,) + SHAPE, np.float32))
+        assert ok.result(timeout=60).shape == (2, 10)
+        assert replica.healthy and replica.recoveries == 1
+    finally:
+        eng.drain()
+    events = _events(str(tmp_path))
+    assert any(e["event"] == "replica_unhealthy" for e in events)
+    assert any(e["event"] == "replica_recovered" for e in events)
+
+
+# --------------------------------------------------------------- deadlines --
+
+
+def test_decode_deadline_sheds_queued_never_kills_inflight(
+    tmp_path, cpu_devices
+):
+    """One slot, slow steps: A starts decoding and outlives its own
+    deadline (in-flight is untouchable); B queues behind it, expires, and
+    is shed with the typed rejection before ever being dispatched."""
+    out = str(tmp_path / "run")
+    eng = DecodeEngine.from_config(
+        _decode_cfg(max_slots=1, kv_blocks=5, max_new_tokens=16,
+                    stats_window=4),
+        out_dir=out,
+        devices=cpu_devices,
+    )
+    eng.start()
+    replica = eng.replicas[0]
+    real_step = replica._step
+
+    def slow_step(*a, **k):
+        time.sleep(0.03)
+        return real_step(*a, **k)
+
+    replica._step = slow_step
+    try:
+        rng = np.random.RandomState(7)
+        # A: ~16 slow steps ≈ 0.5s of decode, deadline 0.15s — it expires
+        # mid-flight and must still complete in full
+        a = eng.submit("t", _prompt(rng, 3), deadline_s=0.15)
+        first = next(a.stream(timeout=120))
+        assert isinstance(first, int)  # in flight before B's verdict
+        # B: queued behind A's slot for ~0.5s, deadline 0.1 -> shed
+        b = eng.submit("t", _prompt(rng, 3), deadline_s=0.1)
+        with pytest.raises(AdmissionError) as e:
+            b.result(timeout=120)
+        assert e.value.reason == "deadline_exceeded"
+        out_a = np.asarray(a.result(timeout=120))
+        assert out_a.shape == (16,)  # never truncated by its deadline
+    finally:
+        summary = eng.drain()
+    assert summary["completed"] == 1
+    assert summary["shed"] == 1
+    assert summary["rejected"]["deadline_exceeded"] == 1
+    history = os.path.join(out, "history.jsonl")
+    errors, _ = schema.validate_history_file(history)
+    assert errors == []
+    windows = [
+        json.loads(l) for l in open(history)
+        if l.strip() and json.loads(l).get("type") == "decode_stats"
+    ]
+    assert sum(w["shed"] for w in windows) == 1
+
+
+def test_serving_request_ttl_sheds_backlog(cpu_devices):
+    """Engine-level admission TTL: with one slow single-request batch in
+    flight, the queued backlog expires and is shed — never dispatched."""
+    eng = ServingEngine.from_config(
+        _serving_cfg(max_batch_size=1, request_ttl_s=0.05),
+        devices=cpu_devices[:1],
+    )
+    eng.start()
+    replica = eng.pool.replicas[0]
+    real_infer = replica.infer
+    replica.infer = lambda x: (time.sleep(0.25), real_infer(x))[1]
+    try:
+        results = [
+            eng.submit("t", np.zeros((1,) + SHAPE, np.float32))
+            for _ in range(3)
+        ]
+        # the first is dispatched immediately (pre-expiry); the rest age
+        # out behind its 0.25s dispatch
+        assert results[0].result(timeout=60).shape == (1, 10)
+        for r in results[1:]:
+            with pytest.raises(AdmissionError) as e:
+                r.result(timeout=60)
+            assert e.value.reason == "deadline_exceeded"
+    finally:
+        summary = eng.drain()
+    assert summary["completed"] == 1
+    assert summary["shed"] == 2
+    assert summary["rejected"]["deadline_exceeded"] == 2
+
+
+# ------------------------------------------------------------ retry budget --
+
+
+def test_retry_budget_transparent_transient_recovery(cpu_devices):
+    """retry_budget=2: a transient dispatch failure re-queues the request
+    and the client sees a clean result — no exception, one retry counted,
+    and the token refunded on success."""
+    eng = ServingEngine.from_config(
+        _serving_cfg(retry_budget=2, unhealthy_after=0),
+        devices=cpu_devices[:1],
+    )
+    eng.start()
+    replica = eng.pool.replicas[0]
+    real_infer = replica.infer
+    state = {"fails": 0}
+
+    def flaky(x):
+        if state["fails"] < 1:
+            state["fails"] += 1
+            raise RuntimeError("transient")
+        return real_infer(x)
+
+    replica.infer = flaky
+    try:
+        res = eng.submit("t", np.ones((2,) + SHAPE, np.float32))
+        assert res.result(timeout=60).shape == (2, 10)
+        assert eng.stats.retries == 1
+        assert eng.retry_budget.used("t") == 0  # refunded on success
+    finally:
+        summary = eng.drain()
+    assert summary["completed"] == 1 and summary["retries"] == 1
+
+
+def test_retry_budget_exhaustion_fails_through(cpu_devices):
+    """Sustained failure: the budget bounds retries PER REQUEST — a
+    request spends its tokens, fails with the dispatch error, and refunds
+    on the way out, so a later same-tenant request gets its own retries
+    (a dead request must not disable retries for unrelated future work)."""
+    eng = ServingEngine.from_config(
+        _serving_cfg(retry_budget=2, unhealthy_after=0),
+        devices=cpu_devices[:1],
+    )
+    eng.start()
+    eng.pool.replicas[0].infer = lambda x: (_ for _ in ()).throw(
+        RuntimeError("persistently dead")
+    )
+    try:
+        res = eng.submit("t", np.ones((1,) + SHAPE, np.float32))
+        with pytest.raises(RuntimeError, match="persistently dead"):
+            res.result(timeout=60)
+        assert eng.stats.retries == 2  # both tokens spent before failing
+        assert eng.retry_budget.used("t") == 0  # refunded at failure-through
+        res2 = eng.submit("t", np.ones((1,) + SHAPE, np.float32))
+        with pytest.raises(RuntimeError):
+            res2.result(timeout=60)
+        assert eng.stats.retries == 4  # its OWN budget, spent and refunded
+        assert eng.retry_budget.used("t") == 0
+    finally:
+        eng.drain()
+
+
+# ---------------------------------------------------------------- schema v7 --
+
+
+def test_v7_run_meta_requires_survivability():
+    meta = schema.make_run_meta(world_size=1)
+    assert "survivability" in meta and meta["survivability"] is None
+    assert schema.validate_record(meta) == []
+    drifted = {k: v for k, v in meta.items() if k != "survivability"}
+    errs = schema.validate_record(drifted)
+    assert errs and any("survivability" in e for e in errs)
+    v6 = dict(drifted)
+    v6["schema_version"] = 6
+    assert schema.validate_record(v6) == []
+
+
+def test_serving_history_carries_shed_window_and_survivability_header(
+    tmp_path, cpu_devices
+):
+    eng = ServingEngine.from_config(
+        _serving_cfg(request_ttl_s=30.0, retry_budget=1),
+        out_dir=str(tmp_path),
+        devices=cpu_devices[:1],
+    )
+    eng.start()
+    try:
+        eng.submit("t", np.zeros((1,) + SHAPE, np.float32)).result(timeout=60)
+    finally:
+        eng.drain()
+    history = os.path.join(str(tmp_path), "history.jsonl")
+    errors, _ = schema.validate_history_file(history)
+    assert errors == []
+    records = [json.loads(l) for l in open(history) if l.strip()]
+    meta = records[0]
+    assert meta["schema_version"] == 7
+    assert meta["survivability"]["request_ttl_s"] == 30.0
+    assert meta["survivability"]["retry_budget"] == 1
+    windows = [r for r in records if r["type"] == "serving_stats"]
+    assert windows and all(
+        "shed" in w and "retries" in w for w in windows
+    )

@@ -273,6 +273,95 @@ def _decode_row(name, mode, d, offered_rps=None, **extra):
     }
 
 
+def _decode_chaos_phase(engine, rng, max_prompt, configs) -> int:
+    """The serving-chaos proof (README "Serving survivability", the full
+    gate's serving-chaos leg): kill a replica MID-SWEEP through the real
+    ``$TPUDDP_FAULT`` env contract and require the survivability layer's
+    headline — zero lost streams, every stream BITWISE-equal to its
+    undisturbed same-seed twin, the replica back in routing after
+    probation — plus the deadline-shedding contract (an expired queued
+    request is rejected typed, never dispatched). Returns 0 on pass; on
+    failure logs FATAL and returns 1 (the caller fails the run)."""
+    from tpuddp.resilience import faults
+    from tpuddp.serving import AdmissionError
+
+    n_sessions = min(6, 2 * engine.replicas[0].cache.max_slots)
+    prompts = _decode_prompts(rng, n_sessions, max_prompt, engine.vocab_size)
+    # undisturbed twins first: same seeds, same temperature-sampled stream
+    twins = [
+        np.asarray(
+            engine.submit("chaos", p, seed=900 + i, temperature=0.9)
+            .result(timeout=300)
+        )
+        for i, p in enumerate(prompts)
+    ]
+    # arm a replica kill a few decode steps ahead via the env contract the
+    # chaos suite documents (tools/run_chaos.py). The engine's fault-site
+    # step counter has advanced exactly once per executed decode step, and
+    # the pool is idle right now — so "current total + 3" lands mid-sweep.
+    steps_now = sum(r.steps for r in engine.replicas)
+    prev = os.environ.get("TPUDDP_FAULT")
+    os.environ["TPUDDP_FAULT"] = f"replica_kill@step={steps_now + 3}"
+    faults.reload_faults()
+    m = engine.stats.mark()
+    try:
+        results = [
+            engine.submit("chaos", p, seed=900 + i, temperature=0.9)
+            for i, p in enumerate(prompts)
+        ]
+        outs = [np.asarray(r.result(timeout=300)) for r in results]
+        fired = all(s.fired for s in faults.active_faults())
+    finally:
+        if prev is None:
+            os.environ.pop("TPUDDP_FAULT", None)
+        else:
+            os.environ["TPUDDP_FAULT"] = prev
+        faults.reload_faults()
+    if not fired:
+        log("FATAL: chaos phase finished without the replica_kill firing")
+        return 1
+    for i, (out, twin) in enumerate(zip(outs, twins)):
+        if not np.array_equal(out, twin):
+            log(f"FATAL: stream {i} diverged from its undisturbed twin "
+                "after failover")
+            return 1
+    # deadline shedding: an already-expired queued request must be shed
+    # with the typed verdict before it can cost a prefill
+    doomed = engine.submit("chaos", prompts[0], deadline_s=0.0)
+    try:
+        doomed.result(timeout=60)
+        log("FATAL: an expired queued request was served, not shed")
+        return 1
+    except AdmissionError as e:
+        if e.reason != "deadline_exceeded":
+            log(f"FATAL: shed rejection carried reason {e.reason!r}, not "
+                "deadline_exceeded")
+            return 1
+    d = engine.stats.since(m)
+    if d["failovers"] < 1:
+        log("FATAL: the kill fired but no session_failover was recorded")
+        return 1
+    if not all(r.healthy for r in engine.replicas):
+        log("FATAL: a replica is still out of routing after probation")
+        return 1
+    configs.update(_decode_row(
+        "chaos_failover", "chaos", d,
+        fault=f"replica_kill@step={steps_now + 3}",
+        sessions=n_sessions,
+        failovers=d["failovers"],
+        shed=d["shed"],
+        bitwise_equal=True,
+        replicas_healthy=sum(1 for r in engine.replicas if r.healthy),
+    ))
+    log(
+        f"chaos: replica_kill mid-sweep -> {d['failovers']} session "
+        f"failover(s), {n_sessions}/{n_sessions} streams bitwise-equal to "
+        f"their undisturbed twins, {d['shed']} expired request(s) shed "
+        "typed, replica back in routing after probation"
+    )
+    return 0
+
+
 def run_decode(args) -> int:
     """The --decode sweep: tokens/sec + TTFT vs offered sequence rate, with
     request-level sequential decode as the vs_baseline anchor."""
@@ -393,6 +482,12 @@ def run_decode(args) -> int:
             f"rejected {rejected}"
         )
 
+    if args.chaos:
+        rc = _decode_chaos_phase(engine, rng, max_prompt, configs)
+        if rc:
+            engine.drain(reason="loadgen_chaos_failed")
+            return rc
+
     summary = engine.drain(reason="loadgen_complete")
 
     import jax
@@ -435,9 +530,12 @@ def run_decode(args) -> int:
         "vs_baseline": payload["vs_baseline"],
         "device": device_kind,
         "n_configs": len(configs),
+        "submitted": summary["submitted"],
         "completed": summary["completed"],
         "tokens": summary["tokens"],
         "rejected": sum(summary["rejected"].values()),
+        "shed": summary["shed"],
+        "failovers": summary["failovers"],
         "results_file": os.path.basename(out_path),
     }), allow_nan=False))
     return 0
@@ -471,6 +569,12 @@ def main(argv=None) -> int:
     parser.add_argument("--decode", action="store_true",
                         help="token-level decode sweep (tokens/sec + TTFT "
                         "curves against the serving.decode engine)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="(--decode only) append the serving-chaos "
+                        "proof: kill a replica mid-sweep via $TPUDDP_FAULT "
+                        "and require zero lost streams, bitwise-equal "
+                        "continuations, typed deadline shedding, and the "
+                        "replica back after probation")
     parser.add_argument("--exporter", nargs="?", const=0, default=None,
                         type=int, metavar="PORT",
                         help="serve the live /metrics endpoint during the "
@@ -478,6 +582,9 @@ def main(argv=None) -> int:
                         "lands in <history-dir>/exporter.port)")
     args = parser.parse_args(argv)
 
+    if args.chaos and not args.decode:
+        parser.error("--chaos requires --decode (the serving-chaos proof "
+                     "runs against the token-level engine)")
     if args.decode:
         return run_decode(args)
 

@@ -8,6 +8,27 @@ exercises the numerical-guard firewall end to end), drives the desync
 auditor's exit-77 and rollback-to-last-good paths, and asserts the
 exit-code and auto-resume contracts documented in README "Fault tolerance".
 
+Serving chaos rides the SAME env contract (README "Serving survivability"):
+
+    TPUDDP_FAULT=replica_kill@step=N    kill a decode replica at global
+                                        decode step N — live sessions park
+                                        into failover journals, migrate,
+                                        and continue BITWISE; the replica
+                                        rejoins after probation
+    TPUDDP_FAULT=pool_poison@step=N     delete the replica's donated K/V
+                                        pool buffers mid-sweep (the real
+                                        accelerator donation death)
+    TPUDDP_FAULT=replica_kill@batch=N   kill a request-serving replica at
+                                        dispatched batch N
+    TPUDDP_FAULT=dispatch_wedge@batch=N fail exactly one dispatch
+                                        transiently (the retry-budget
+                                        exercise; dispatch_wedge@step=N is
+                                        the decode-side equivalent)
+
+``tools/loadgen.py --decode --chaos`` drives the full headline proof
+(kill mid-sweep -> zero lost streams, bitwise-equal to undisturbed twins)
+and ``tools/run_full_gate.py`` runs it as the serving-chaos leg.
+
 Usage: python tools/run_chaos.py [extra pytest args]
 """
 

@@ -58,7 +58,21 @@ is SIGTERMed mid-decode and must let every in-flight sequence finish
 streaming (summary ``completed == submitted``, zero truncation) before
 exiting 75 — the resilience drain contract at token granularity.
 
-Elastic-resume gate (after the decode gate): a bf16_ef training run on 4
+Serving-chaos gate (after the decode gate): ``tools/loadgen.py --decode
+--quick --chaos`` re-runs the token sweep and then kills a replica
+MID-SWEEP through the real ``$TPUDDP_FAULT`` env contract
+(``replica_kill@step=N``). The survivability layer (ISSUE 13,
+tpuddp/serving/survive.py) must lose ZERO streams: every live sequence
+parks into its session journal, fails over, and completes **bitwise-equal**
+to an undisturbed same-seed twin (loadgen verifies the equality in-process
+and this leg re-checks the accounting: completed == submitted - shed); the
+killed replica passes probation and rejoins routing
+(``replica_recovered``); an expired queued request is shed with a typed
+``deadline_exceeded`` rejection; and both artifacts — the history with its
+``session_failover``/``replica_recovered`` event rows and the bench curve's
+chaos row — validate under schema v7.
+
+Elastic-resume gate (after the serving-chaos gate): a bf16_ef training run on 4
 local devices is preempted (injected SIGTERM -> exit 75, emergency
 checkpoint), then resumed on 2 devices THROUGH the restart supervisor
 (tools/supervise.py) — the v2 checkpoint reshards onto the smaller world.
@@ -336,6 +350,99 @@ def _decode_gate(env) -> int:
             return rc
         print("decode gate: token sweep artifacts valid + SIGTERM drain "
               f"finished all {n_demo} in-flight sequences (exit 75)")
+    return 0
+
+
+def _serving_chaos_gate(env) -> int:
+    """Serving-chaos leg (ISSUE 13, README "Serving survivability"): the
+    decode sweep re-runs with ``--chaos`` — a replica is killed MID-SWEEP
+    via the real ``$TPUDDP_FAULT`` contract and loadgen itself enforces the
+    bitwise headline (every migrated stream equal to its undisturbed
+    same-seed twin, replica back after probation, typed deadline shed).
+    This leg re-checks the OBSERVABLE evidence independently: the summary
+    accounting (zero lost streams: completed == submitted - shed, with
+    >= 1 failover and >= 1 shed), the ``session_failover`` /
+    ``replica_recovered`` event rows in history.jsonl, and schema-v7
+    validity of both artifacts."""
+    import json
+
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    with tempfile.TemporaryDirectory(prefix="tpuddp_schaos_gate_") as out_dir:
+        worker_env = dict(env)
+        worker_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        bench_json = os.path.join(out_dir, "bench_results.json")
+        out = subprocess.run(
+            [
+                sys.executable, "-u",
+                os.path.join(REPO, "tools", "loadgen.py"),
+                "--decode", "--quick", "--chaos",
+                "--replicas", "2", "--tenants", "2",
+                "--history-dir", out_dir, "--out", bench_json,
+            ],
+            cwd=REPO, env=worker_env, stdout=subprocess.PIPE, text=True,
+        )
+        sys.stdout.write(out.stdout)
+        if out.returncode != 0:
+            print(f"serving-chaos gate: loadgen --chaos exited "
+                  f"{out.returncode}", file=sys.stderr)
+            return out.returncode
+        summary = json.loads(
+            [l for l in out.stdout.splitlines() if l.strip()][-1]
+        )
+        if summary.get("failovers", 0) < 1 or summary.get("shed", 0) < 1:
+            print(
+                "serving-chaos gate: the chaos phase left no evidence "
+                f"(failovers={summary.get('failovers')}, "
+                f"shed={summary.get('shed')})", file=sys.stderr,
+            )
+            return 1
+        expected = summary.get("submitted", 0) - summary.get("shed", 0)
+        if summary.get("completed") != expected:
+            print(
+                "serving-chaos gate: streams were lost (completed "
+                f"{summary.get('completed')} != submitted "
+                f"{summary.get('submitted')} - shed {summary.get('shed')})",
+                file=sys.stderr,
+            )
+            return 1
+        history = os.path.join(out_dir, "history.jsonl")
+        events = set()
+        with open(history) as f:
+            for line in f:
+                if line.strip():
+                    rec = json.loads(line)
+                    if rec.get("type") == "event":
+                        events.add(rec.get("event"))
+        for required in ("session_failover", "replica_unhealthy",
+                         "replica_recovered"):
+            if required not in events:
+                print(
+                    f"serving-chaos gate: required event {required!r} "
+                    f"missing from history (saw {sorted(events)})",
+                    file=sys.stderr,
+                )
+                return 1
+        for artifact in (history, bench_json):
+            rc = subprocess.call(
+                [sys.executable, inspect, "--validate", artifact],
+                cwd=REPO, env=env,
+            )
+            if rc != 0:
+                print(
+                    f"serving-chaos gate: {os.path.basename(artifact)} "
+                    "failed validation", file=sys.stderr,
+                )
+                return rc
+        print(
+            "serving-chaos gate: replica killed mid-sweep, zero lost "
+            f"streams ({summary['completed']} completed, "
+            f"{summary['failovers']} failover(s), {summary['shed']} typed "
+            "shed), events + schema v7 verified"
+        )
     return 0
 
 
@@ -851,6 +958,9 @@ def main(argv=None):
     if rc != 0:
         return rc
     rc = _decode_gate(env)
+    if rc:
+        return rc
+    rc = _serving_chaos_gate(env)
     if rc != 0:
         return rc
     rc = _elastic_gate(env)

@@ -169,6 +169,15 @@ def summarize_history(path: str) -> None:
             print(f"  {'':>20}  temperature={dec.get('temperature')} "
                   f"stop_token={dec.get('stop_token')} "
                   f"prefill_buckets={dec.get('prefill_buckets')}")
+        # survivability provenance (required since schema v7; null = not a
+        # serving writer): the deadline/probation/retry knob block
+        sur = m.get("survivability")
+        if isinstance(sur, dict):
+            print(f"  {'survivability':>20}: "
+                  f"request_ttl_s={sur.get('request_ttl_s')} "
+                  f"max_recoveries={sur.get('max_recoveries')} "
+                  f"recovery_attempts={sur.get('recovery_attempts')} "
+                  f"retry_budget={sur.get('retry_budget')}")
     else:
         print("run_meta: MISSING (pre-schema history?)")
 
@@ -242,15 +251,21 @@ def summarize_history(path: str) -> None:
                 _fmt(s.get("e2e_ms_p99"), 2),
                 _fmt(s.get("throughput_rps"), 0),
                 _fmt(s.get("batch_occupancy"), 3),
+                str(s.get("shed") if s.get("shed") is not None else "-"),
+                str(s.get("retries")
+                    if s.get("retries") is not None else "-"),
             ])
         _print_table(rows, [
             "win", "req", "done", "rej", "q50ms", "d50ms",
-            "e2e50", "e2e95", "e2e99", "rps", "occ",
+            "e2e50", "e2e95", "e2e99", "rps", "occ", "shed", "rty",
         ])
         done = sum(s.get("completed") or 0 for s in serving)
         rej = sum(s.get("rejected") or 0 for s in serving)
+        shed = sum(s.get("shed") or 0 for s in serving)
+        retries = sum(s.get("retries") or 0 for s in serving)
         worst = max((s.get("e2e_ms_p99") or 0) for s in serving)
-        print(f"  totals: {done} completed, {rej} rejected, "
+        print(f"  totals: {done} completed, {rej} rejected "
+              f"({shed} shed past deadline), {retries} retried, "
               f"worst-window e2e p99 {worst:.2f} ms")
 
     if decode:
@@ -274,17 +289,24 @@ def summarize_history(path: str) -> None:
                 _fmt(s.get("kv_occupancy"), 3),
                 str(s.get("active_sequences")
                     if s.get("active_sequences") is not None else "-"),
+                str(s.get("shed") if s.get("shed") is not None else "-"),
+                str(s.get("failovers")
+                    if s.get("failovers") is not None else "-"),
             ])
         _print_table(rows, [
             "win", "tok", "done", "rej", "tok/s", "ttft50", "ttft95",
-            "itl50", "itl99", "kvocc", "act",
+            "itl50", "itl99", "kvocc", "act", "shed", "fo",
         ])
         tok = sum(s.get("tokens") or 0 for s in decode)
         done = sum(s.get("completed") or 0 for s in decode)
+        shed = sum(s.get("shed") or 0 for s in decode)
+        failovers = sum(s.get("failovers") or 0 for s in decode)
         worst_itl = max((s.get("itl_ms_p99") or 0) for s in decode)
         peak_kv = max((s.get("kv_occupancy") or 0) for s in decode)
-        print(f"  totals: {tok} tokens across {done} sequences, worst-window "
-              f"ITL p99 {worst_itl:.2f} ms, peak KV occupancy {peak_kv:.3f}")
+        print(f"  totals: {tok} tokens across {done} sequences "
+              f"({shed} shed past deadline, {failovers} session "
+              f"failover(s)), worst-window ITL p99 {worst_itl:.2f} ms, "
+              f"peak KV occupancy {peak_kv:.3f}")
 
     # gradient-comm byte savings: compressed vs the f32 baseline the header
     # records. ONLY the latest run segment's epochs belong to the latest
@@ -315,6 +337,21 @@ def summarize_history(path: str) -> None:
             if inter is not None and intra:
                 print(f"  hop split: {inter:,} B inter-host (compressed) + "
                       f"{intra:,} B intra-host (f32 ICI) per update")
+
+    # survivability episode rollup (schema v7): one line a chaos gate (or
+    # an operator) reads to know how many sessions migrated, which
+    # replicas came back, and whether the pool ever terminally died
+    sur_counts = {
+        kind: sum(1 for ev in events if ev.get("event") == kind)
+        for kind in (
+            "session_failover", "replica_unhealthy", "replica_recovered",
+            "replica_removed", "no_healthy_replica",
+        )
+    }
+    if any(sur_counts.values()):
+        print("\nsurvivability: " + ", ".join(
+            f"{k}={v}" for k, v in sur_counts.items() if v
+        ))
 
     if events:
         print(f"\nevents ({len(events)}):")

@@ -139,8 +139,28 @@ SERVING_DEFAULTS = {
     "stats_window": 64,  # completed requests per serving_stats history row
     "unhealthy_after": 3,  # graceful degradation: K consecutive dispatch
     # errors mark a replica unhealthy (stop routing to it, emit a
-    # replica_unhealthy event row); healthy replicas keep serving. 0 never
-    # marks (every batch on a broken replica fails individually).
+    # replica_unhealthy event row) and send it to PROBATION (see the
+    # survivability knobs below). 0 never marks (every batch on a broken
+    # replica fails individually).
+    # -- survivability knobs (tpuddp/serving/survive.py, README "Serving
+    # survivability"):
+    "request_ttl_s": None,  # admission-time deadline: a request still
+    # QUEUED this long after submit is shed with reason deadline_exceeded
+    # before it wastes device time (in-flight work is never deadline-
+    # killed); None -> no TTL. Clients may pass a tighter per-call
+    # deadline_s to submit() either way.
+    "max_recoveries": 2,  # lifetime probation episodes per replica: an
+    # unhealthy replica rebuilds + canaries with jittered backoff and
+    # rejoins routing on success (replica_recovered event); past this many
+    # rejoins the next incident removes it permanently (the fallback, not
+    # the policy)
+    "recovery_attempts": 2,  # rebuild+canary tries within one probation
+    # episode (resilience/retry.py jittered exponential backoff between)
+    "recovery_backoff_s": 0.1,  # base backoff between in-episode tries
+    "retry_budget": 0,  # per-tenant transient-dispatch retry tokens: a
+    # failed batch's requests re-enter the queue (front of lane) within
+    # this budget instead of failing through; tokens are refunded when a
+    # retried request succeeds. 0 disables (failures surface immediately).
     "seed": 0,  # fresh-init parameter seed (ignored with a checkpoint)
     "decode": None,  # autoregressive decode block (tpuddp/serving/decode/):
     # None -> request-granularity CNN serving only; a dict (or true for all
@@ -179,6 +199,24 @@ DECODE_DEFAULTS = {
     "max_queue_depth": 256,  # admission control, as the outer serving block
     "per_tenant_quota": None,
     "stats_window": 64,  # generated tokens per decode_stats history row
+    # -- survivability knobs (tpuddp/serving/survive.py): same semantics as
+    # the outer serving block. A decode replica that dies mid-stream parks
+    # its live sequences into host-side session journals; they fail over
+    # to a healthy replica (or to this one, once it passes probation) and
+    # continue BITWISE-equal to an undisturbed run. No retry_budget here:
+    # the failover journal is the decode path's retry mechanism.
+    "request_ttl_s": None,  # shed requests still queued this long after
+    # submit (deadline_exceeded); in-flight sequences are never killed
+    "max_recoveries": 2,  # lifetime probation episodes per decode replica
+    "recovery_attempts": 2,  # rebuild-KV-pool + canary tries per episode
+    "recovery_backoff_s": 0.1,  # base jittered backoff between tries
+    "max_failovers": 1,  # per-SESSION failover episodes, charged only to
+    # the attributed CULPRIT of a place-phase incident: past the budget
+    # the request fails with the dispatch error instead of re-parking —
+    # the poisoned-request firewall (a request whose own content kills
+    # any dispatch must not ride its journal around the pool; innocent
+    # sessions parked by someone else's incident ride free). 0 = a
+    # culprit is never re-parked (legacy stream-dies behavior).
     "seed": 0,  # fresh-init parameter seed (ignored with a checkpoint)
 }
 

@@ -104,7 +104,20 @@ def scrape_job(run_dir: str, timeout: float = 2.0) -> Optional[dict]:
         logger.warning("autoscale: scrape of %s failed: %s", run_dir, e)
         return None
     completed = metric_value(families, "tpuddp_serving_completed_total")
+    tokens = metric_value(families, "tpuddp_decode_tokens_total")
     steps = metric_value(families, "tpuddp_train_steps_total")
+    # survivability (schema v7): a decode job exports decode_shed_total
+    # where the request engine exports serving_shed_total — either one is
+    # "work shed past its deadline", the overload signal the shed-rate
+    # scale-up rule consumes
+    shed = metric_value(families, "tpuddp_serving_shed_total")
+    if shed is None:
+        shed = metric_value(families, "tpuddp_decode_shed_total")
+    cursor = completed
+    if cursor is None:
+        cursor = tokens
+    if cursor is None:
+        cursor = steps
     return {
         "p99_ms": metric_value(
             families, "tpuddp_serving_e2e_ms", quantile="0.99"
@@ -113,7 +126,8 @@ def scrape_job(run_dir: str, timeout: float = 2.0) -> Optional[dict]:
         "straggler_events": metric_value(
             families, "tpuddp_pod_straggler_events_total"
         ),
-        "fresh_cursor": completed if completed is not None else steps,
+        "shed_total": shed,
+        "fresh_cursor": cursor,
         "port": port,
     }
 
@@ -124,6 +138,10 @@ class AutoscalePolicy:
     """The knob table (README "Fleet operations").
 
     ``slo_p99_ms``/``occupancy_high`` arm serving scale-up;
+    ``shed_high`` arms the survivability scale-up rule: >= this many NEWLY
+    shed requests (``tpuddp_serving_shed_total`` / ``decode_shed_total``
+    delta) in a fresh window is a breach — the engine is dropping
+    deadline-expired work, the most direct overload evidence there is;
     ``scale_down_below`` (fraction of the SLO) arms scale-down;
     ``hysteresis`` fresh breached observations are required before any
     action, and ``cooldown_s`` bounds the action rate per job.
@@ -131,6 +149,7 @@ class AutoscalePolicy:
 
     slo_p99_ms: Optional[float] = None
     occupancy_high: Optional[float] = None
+    shed_high: Optional[int] = None
     scale_down_below: float = 0.25
     hysteresis: int = 2
     cooldown_s: float = 30.0
@@ -140,6 +159,10 @@ class AutoscalePolicy:
     def __post_init__(self):
         if self.hysteresis < 1:
             raise ValueError(f"hysteresis must be >= 1, got {self.hysteresis}")
+        if self.shed_high is not None and self.shed_high < 1:
+            raise ValueError(
+                f"shed_high must be >= 1 or None, got {self.shed_high}"
+            )
         if self.cooldown_s < 0:
             raise ValueError(f"cooldown_s must be >= 0, got {self.cooldown_s}")
         if not (0.0 <= self.scale_down_below < 1.0):
@@ -171,6 +194,7 @@ class Autoscaler:
         self._cursor: Dict[str, object] = {}
         self._last_action: Dict[str, float] = {}
         self._stragglers_seen: Dict[str, float] = {}
+        self._shed_seen: Dict[str, float] = {}
         self.actions: List[dict] = []  # audit trail (tests + CLI logging)
 
     # ------------------------------------------------------------ helpers --
@@ -240,12 +264,25 @@ class Autoscaler:
         # serving: SLO-driven replica scaling
         p99 = obs.get("p99_ms")
         occ = obs.get("occupancy")
+        # shed-rate rule (survivability, schema v7): newly shed work since
+        # the last FRESH observation is overload evidence — the first
+        # observation is a baseline, never a breach
+        shed_now = obs.get("shed_total")
+        shed_delta = 0.0
+        if shed_now is not None:
+            seen = self._shed_seen.get(name)
+            if seen is not None:
+                shed_delta = shed_now - seen
+            if fresh or seen is None:
+                self._shed_seen[name] = shed_now
         breach = (
             pol.slo_p99_ms is not None and p99 is not None and p99 > pol.slo_p99_ms
         ) or (
             pol.occupancy_high is not None
             and occ is not None
             and occ > pol.occupancy_high
+        ) or (
+            pol.shed_high is not None and shed_delta >= pol.shed_high
         )
         low = (
             pol.slo_p99_ms is not None
@@ -262,8 +299,8 @@ class Autoscaler:
         ):
             self._record(
                 name, now, "scale_up", current + 1,
-                f"p99 {p99} ms / occupancy {occ} breached for "
-                f"{self._breach[name]} fresh window(s)",
+                f"p99 {p99} ms / occupancy {occ} / shed +{shed_delta:.0f} "
+                f"breached for {self._breach[name]} fresh window(s)",
             )
             return current + 1
         if (

@@ -41,10 +41,19 @@ tpuddp/serving/decode/: tokens/sec, time-to-first-token, inter-token
 latency percentiles, KV-cache occupancy) and the required run_meta
 ``decode`` provenance field (null = not a decode run; a decode header
 carries the KV-pool geometry, so a reader can tell "no decode windows"
-from "this was never a decode engine"). Readers accept every version up to
-their own ``SCHEMA_VERSION`` and reject newer files; the per-version
-required-field sets apply at the version each record CARRIES, so a v2
-history (no occupancy fields) stays valid under a v5 reader.
+from "this was never a decode engine"); v7 added the serving
+survivability layer's accounting (tpuddp/serving/survive.py): the required
+run_meta ``survivability`` provenance field (null = not a serving writer;
+a serving header carries the TTL / probation / retry-budget knobs), the
+required ``shed`` field on ``serving_stats`` and ``decode_stats`` windows
+(deadline-expired requests dropped before dispatch) and the required
+``failovers`` field on ``decode_stats`` (sessions migrated off a dead
+replica), plus the typed ``session_failover`` / ``replica_recovered`` /
+``replica_removed`` / ``no_healthy_replica`` event rows. Readers accept
+every version up to their own ``SCHEMA_VERSION`` and reject newer files;
+the per-version required-field sets apply at the version each record
+CARRIES, so a v2 history (no occupancy fields) stays valid under a v5
+reader.
 """
 
 from __future__ import annotations
@@ -54,7 +63,7 @@ import hashlib
 import json
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 RECORD_TYPES = (
     "run_meta", "epoch", "step_stats", "event", "serving_stats",
@@ -165,6 +174,18 @@ _REQUIRED_SINCE = {
     6: {
         "run_meta": ("decode",),
     },
+    # v7: the serving survivability layer (tpuddp/serving/survive.py).
+    # run_meta.survivability is null for non-serving writers but the KEY
+    # must exist (a reader must tell "no sheds because the layer was off"
+    # from "predates the layer"); serving/decode windows carry their shed
+    # counts and decode windows their session-failover counts, so the
+    # autoscaler's shed-rate rule and the chaos gate read typed records,
+    # not log lines.
+    7: {
+        "run_meta": ("survivability",),
+        "serving_stats": ("shed",),
+        "decode_stats": ("shed", "failovers"),
+    },
 }
 
 def stamp(record_type: str, record: dict) -> dict:
@@ -196,6 +217,7 @@ def make_run_meta(
     guard=None,
     observability: Optional[dict] = None,
     decode: Optional[dict] = None,
+    survivability: Optional[dict] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Build the run_meta header row from live run objects.
@@ -244,6 +266,10 @@ def make_run_meta(
         # required since schema v6: the decode engine's provenance (model,
         # slot width, KV-pool geometry; null = not an autoregressive run)
         "decode": decode,
+        # required since schema v7: the serving survivability knobs
+        # (request TTL, probation bounds, retry budget; null = not a
+        # serving writer — training runs have no shedding/failover story)
+        "survivability": survivability,
     }
     if extra:
         record.update(extra)

@@ -13,6 +13,16 @@ that crosses the process boundary without patching code.  Grammar::
             nan      poison one train micro-batch so its loss/gradient go
                      non-finite — exercises the numerical-guard firewall
                      (resilience/guard.py) end to end
+            replica_kill    [serving] mark the dispatching replica
+                     persistently dead (every dispatch raises until its
+                     probation rebuild) — the in-process SIGKILL analog
+                     that drives decode-session failover + recovery
+            pool_poison     [serving/decode] delete the replica's donated
+                     K/V pool buffers mid-sweep and fail the dispatch —
+                     the donated-buffer death real accelerators produce
+            dispatch_wedge  [serving] fail exactly one dispatch
+                     transiently — the retry-budget / single-incident
+                     exercise (the next dispatch succeeds)
 
     sites:  epoch=N  checked by the epoch driver at the start of epoch N
             barrier  checked on entry to collectives.barrier
@@ -23,14 +33,26 @@ that crosses the process boundary without patching code.  Grammar::
                      MID-epoch — the elastic-resume resize scenarios
                      (tests/test_chaos.py), where the drain's emergency
                      checkpoint carries mid-epoch state and the resumed run
-                     (possibly on a different world size) redoes the epoch
+                     (possibly on a different world size) redoes the epoch.
+                     The DECODE engine checks the same site per decode step
+                     (its own global step counter) for the serving kinds —
+                     ``replica_kill@step=N`` / ``pool_poison@step=N`` /
+                     ``dispatch_wedge@step=N`` land mid-token-sweep
+            batch=N  checked by the request-granularity serving engine per
+                     dispatched batch (engine-global index): accepts the
+                     serving kinds (``replica_kill@batch=N``,
+                     ``dispatch_wedge@batch=N``)
 
 Examples: ``crash@epoch=2``, ``preempt@epoch=1``, ``hang@barrier``,
-``corrupt@ckpt_1``, ``nan@step=5``, ``preempt@step=12``.  Each spec fires at
+``corrupt@ckpt_1``, ``nan@step=5``, ``preempt@step=12``,
+``replica_kill@batch=3``, ``pool_poison@step=40``.  Each spec fires at
 most once per
 process.  Parsing is lazy and cached; :func:`reload_faults` re-reads the env
 (test isolation).  Production runs without the env variable pay one cached
-dict lookup per hook.
+dict lookup per hook.  Training hooks (:func:`maybe_fire`) never consume
+the serving kinds and the serving hook (:func:`maybe_serving_fault`) never
+consumes the training kinds, so one env spec can target either plane
+unambiguously.
 """
 
 from __future__ import annotations
@@ -47,7 +69,10 @@ from tpuddp.resilience.preemption import EXIT_INJECTED_CRASH
 logger = logging.getLogger("tpuddp")
 
 _FAULT_ENV = "TPUDDP_FAULT"
-_KINDS = ("crash", "preempt", "hang", "corrupt", "nan")
+# serving-side kinds (tpuddp/serving/): consumed ONLY by
+# maybe_serving_fault — the training hooks skip them entirely
+SERVING_KINDS = ("replica_kill", "pool_poison", "dispatch_wedge")
+_KINDS = ("crash", "preempt", "hang", "corrupt", "nan") + SERVING_KINDS
 
 _cache = {"raw": None, "specs": None}
 _hung = {"active": False}
@@ -69,6 +94,8 @@ class FaultSpec:
             return ctx.get("name") == self.arg
         if self.site == "step":
             return str(ctx.get("step")) == self.arg
+        if self.site == "batch":
+            return str(ctx.get("batch")) == self.arg
         return True  # barrier (and other argless sites)
 
 
@@ -93,26 +120,43 @@ def parse_fault_specs(raw: str) -> List[FaultSpec]:
             specs.append(FaultSpec(kind, "ckpt", point))
         elif point.startswith("step="):
             specs.append(FaultSpec(kind, "step", point[len("step=") :]))
+        elif point.startswith("batch="):
+            specs.append(FaultSpec(kind, "batch", point[len("batch=") :]))
         else:
             raise ValueError(
                 f"bad {_FAULT_ENV} site {point!r}; expected epoch=N, barrier, "
-                "ckpt_N, or step=N"
+                "ckpt_N, step=N, or batch=N"
             )
         # kind/site pairing: nan only makes sense at the batch-level step
-        # site; the step site accepts nan (batch poisoning) plus the
+        # site; the step site accepts nan (batch poisoning), the
         # process-killing kinds crash/preempt (mid-epoch kills for the
-        # elastic chaos matrix). hang/corrupt at step=N would be typos —
-        # refuse them loudly.
+        # elastic chaos matrix), and the serving kinds (the decode engine
+        # checks step=N per decode step). batch=N is the request-serving
+        # dispatch site and takes serving kinds only (pool_poison needs a
+        # KV pool, so it stays on the decode step site). Anything else at
+        # these sites would be a typo — refuse it loudly.
         spec = specs[-1]
         if spec.kind == "nan" and spec.site != "step":
             raise ValueError(
                 f"bad {_FAULT_ENV} spec {part!r}: kind 'nan' pairs with site "
                 "step=N"
             )
-        if spec.site == "step" and spec.kind not in ("nan", "crash", "preempt"):
+        step_kinds = ("nan", "crash", "preempt") + SERVING_KINDS
+        if spec.site == "step" and spec.kind not in step_kinds:
             raise ValueError(
                 f"bad {_FAULT_ENV} spec {part!r}: site step=N accepts kinds "
-                "'nan', 'crash', or 'preempt'"
+                f"{step_kinds}"
+            )
+        batch_kinds = ("replica_kill", "dispatch_wedge")
+        if spec.site == "batch" and spec.kind not in batch_kinds:
+            raise ValueError(
+                f"bad {_FAULT_ENV} spec {part!r}: site batch=N accepts kinds "
+                f"{batch_kinds}"
+            )
+        if spec.kind in SERVING_KINDS and spec.site not in ("step", "batch"):
+            raise ValueError(
+                f"bad {_FAULT_ENV} spec {part!r}: serving kind "
+                f"{spec.kind!r} pairs with the dispatch sites step=N/batch=N"
             )
     return specs
 
@@ -147,10 +191,35 @@ def has_nan_fault() -> bool:
 
 
 def has_step_fault() -> bool:
-    """True while ANY un-fired step-site spec is armed (nan poison or a
-    mid-epoch crash/preempt kill) — the epoch driver wires its per-batch
-    injection hook only then."""
-    return any(s.site == "step" and not s.fired for s in active_faults())
+    """True while ANY un-fired TRAINING step-site spec is armed (nan poison
+    or a mid-epoch crash/preempt kill) — the epoch driver wires its
+    per-batch injection hook only then. Serving kinds at step=N belong to
+    the decode engine's hook, not the trainer's."""
+    return any(
+        s.site == "step" and not s.fired and s.kind not in SERVING_KINDS
+        for s in active_faults()
+    )
+
+
+def maybe_serving_fault(site: str, **ctx) -> Optional[str]:
+    """The serving engines' injection hook: returns the serving fault kind
+    that fired at this site (``replica_kill`` / ``pool_poison`` /
+    ``dispatch_wedge``) or None. Only serving kinds are considered — a
+    training spec sharing the env never gets consumed here — and each spec
+    fires at most once, like every other fault. The engine interprets the
+    kind (mark the replica broken / delete its pools / raise once); this
+    function only decides and logs."""
+    for spec in active_faults():
+        if spec.kind not in SERVING_KINDS:
+            continue
+        if not spec.matches(site, **ctx):
+            continue
+        spec.fired = True
+        logger.critical(
+            "fault injection: %s@%s fired (ctx=%s)", spec.kind, site, ctx
+        )
+        return spec.kind
+    return None
 
 
 def maybe_corrupt_batch(batch, step: int):
@@ -196,6 +265,9 @@ def maybe_fire(site: str, **ctx) -> None:
         if spec.kind == "nan":
             continue  # batch poisoning is maybe_corrupt_batch's job — firing
             # it here would mark the spec consumed without poisoning anything
+        if spec.kind in SERVING_KINDS:
+            continue  # the serving engines' hook (maybe_serving_fault) owns
+            # these — firing one here would consume it without injecting
         if not spec.matches(site, **ctx):
             continue
         spec.fired = True

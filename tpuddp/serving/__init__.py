@@ -54,9 +54,17 @@ from tpuddp.serving.queue import (  # noqa: F401
 from tpuddp.serving.replica import Replica, ReplicaPool  # noqa: F401
 from tpuddp.serving.scheduler import Batch, BatchScheduler  # noqa: F401
 from tpuddp.serving.stats import ServingStats  # noqa: F401
+from tpuddp.serving.survive import (  # noqa: F401
+    NoHealthyReplicaError,
+    RetryBudget,
+    SurvivePolicy,
+)
 
 __all__ = [
     "AdmissionError",
+    "NoHealthyReplicaError",
+    "RetryBudget",
+    "SurvivePolicy",
     "Batch",
     "BatchScheduler",
     "DecodeEngine",

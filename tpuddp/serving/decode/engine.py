@@ -58,10 +58,13 @@ import numpy as np
 from tpuddp.models import load_model
 from tpuddp.models.transformer import TransformerLM, prefill_buckets
 from tpuddp.observability import MetricsWriter, schema
+from tpuddp.resilience import faults
 from tpuddp.serving import queue as queue_mod
+from tpuddp.serving import survive as survive_lib
 from tpuddp.serving.decode.cache import PagedKVCache
 from tpuddp.serving.decode.stats import DecodeStats
 from tpuddp.serving.queue import AdmissionError, RequestQueue, ServedResult
+from tpuddp.serving.survive import NoHealthyReplicaError, SurvivePolicy
 from tpuddp.utils import batching
 
 logger = logging.getLogger("tpuddp")
@@ -113,16 +116,26 @@ class DecodeRequest:
     """One admitted decode request. Duck-types the queue's ``Request``
     protocol (tenant / rows / key / id / t_enqueue) so :class:`RequestQueue`
     admission, per-tenant lanes, and round-robin fairness apply unchanged —
-    every request is one row of the same key, so any group assembles."""
+    every request is one row of the same key, so any group assembles.
+
+    Survivability fields: ``deadline`` (absolute; a request still QUEUED
+    past it is shed — an in-flight stream is never deadline-killed);
+    ``resume_tokens`` is the session-failover journal — None for a fresh
+    request, a list of the tokens already streamed to the client when the
+    request is re-queued after its replica died (``[]`` = it died during
+    prefill, before the first token); ``failed_from`` names the dead
+    replica for the ``session_failover`` event."""
 
     __slots__ = (
         "id", "tenant", "tokens", "max_new_tokens", "temperature", "seed",
         "stop_token", "rows", "key", "t_enqueue", "result",
+        "deadline", "resume_tokens", "failed_from", "failovers",
     )
 
     def __init__(
         self, tenant: str, tokens: np.ndarray, max_new_tokens: int,
         temperature: float, seed: int, stop_token: Optional[int],
+        deadline: Optional[float] = None,
     ):
         self.id = next(_ids)
         self.tenant = str(tenant)
@@ -135,6 +148,13 @@ class DecodeRequest:
         self.key = ("decode",)
         self.t_enqueue = time.perf_counter()
         self.result = StreamedResult()
+        self.deadline = deadline
+        self.resume_tokens: Optional[List[int]] = None
+        self.failed_from: Optional[int] = None
+        # times this session was parked into its journal by a replica
+        # incident; bounded by SurvivePolicy.max_failovers (the
+        # poisoned-request firewall)
+        self.failovers = 0
 
     @property
     def total_tokens(self) -> int:
@@ -143,9 +163,21 @@ class DecodeRequest:
 
 
 class _Active:
-    """One sequence occupying a decode slot."""
+    """One sequence occupying a decode slot.
 
-    __slots__ = ("req", "slot", "last_token", "n_generated", "out", "t_last")
+    ``replay`` (session failover): tokens this sequence already streamed to
+    its client whose K/V must be re-committed on the new replica. While
+    non-empty, each decode step feeds the next recorded token instead of
+    sampling and delivers NOTHING (the client saw these tokens already);
+    once the replay drains, live sampling resumes at token index
+    ``n_generated`` — and because every K/V position was rebuilt by the
+    same program kind that wrote it originally (prompt by prefill, replay
+    tokens by the step) and sampling is keyed by ``(seed, index)`` only,
+    the continued stream is bitwise the undisturbed one."""
+
+    __slots__ = (
+        "req", "slot", "last_token", "n_generated", "out", "t_last", "replay",
+    )
 
     def __init__(self, req: DecodeRequest, slot: int, first_token: int):
         self.req = req
@@ -154,6 +186,7 @@ class _Active:
         self.n_generated = 1
         self.out = [first_token]
         self.t_last = time.perf_counter()
+        self.replay: List[int] = []
 
 
 def _sample(logits_row: np.ndarray, temperature: float, seed: int, index: int) -> int:
@@ -200,6 +233,65 @@ class DecodeReplica:
         self._prefill = jax.jit(model.prefill, donate_argnums=(1, 2))
         self._step = jax.jit(model.decode_step, donate_argnums=(1, 2))
         self.steps = 0
+        # survivability state machine (tpuddp/serving/survive.py):
+        # healthy -> recovering (probation) -> healthy | removed. ``broken``
+        # simulates device death (replica_kill chaos): every dispatch
+        # raises until rebuild() clears it. ``recoveries`` counts lifetime
+        # probation rejoins, bounded by the policy's max_recoveries.
+        self.state = "healthy"
+        self.recoveries = 0
+        self.broken = False
+        # True while this replica's decode-loop THREAD is running — the
+        # survivor check must not hand failover journals to a peer whose
+        # loop already exited at drain (state alone cannot tell)
+        self.loop_alive = False
+
+    @property
+    def healthy(self) -> bool:
+        return self.state == "healthy"
+
+    def check_broken(self) -> None:
+        if self.broken:
+            raise RuntimeError(
+                f"decode replica {self.index} is down (injected replica_kill)"
+            )
+
+    def rebuild(self) -> None:
+        """Probation step 1: fresh KV pool + block-table allocator (every
+        sequence that lived here has been parked into the failover journal)
+        and cleared kill flag — the restarted-device state."""
+        self.cache = PagedKVCache(
+            layers=self.cache.layers,
+            heads=self.cache.heads,
+            head_dim=self.cache.head_dim,
+            num_blocks=self.cache.num_blocks,
+            block_size=self.cache.block_size,
+            max_slots=self.cache.max_slots,
+            max_seq_len=self.cache.max_seq_len,
+        )
+        shape = self.cache.pool_shape()
+        self.kpool = jax.device_put(jnp.zeros(shape, jnp.float32), self.device)
+        self.vpool = jax.device_put(jnp.zeros(shape, jnp.float32), self.device)
+        self.broken = False
+
+    def canary(self, buckets: List[int]) -> None:
+        """Probation step 2: re-warm (the bucket ladder + step program are
+        already compiled; this re-executes them against the fresh pools)
+        and require a finite canary step — a replica that cannot decode the
+        canary does not rejoin routing."""
+        self.check_broken()
+        self.warmup(buckets)
+        S = self.cache.max_slots
+        out, self.kpool, self.vpool = self._step(
+            self.params, self.kpool, self.vpool,
+            jnp.zeros((S, self.cache.max_blocks), jnp.int32),
+            jnp.zeros((S,), jnp.int32), jnp.zeros((S,), jnp.int32),
+        )
+        if not np.all(np.isfinite(np.asarray(out))):
+            raise RuntimeError(
+                f"decode replica {self.index} canary produced non-finite "
+                "logits"
+            )
 
     def warmup(self, buckets: List[int]) -> None:
         """Compile every prefill bucket + the step program now. Warmup
@@ -292,6 +384,10 @@ class DecodeEngine:
             int(cfg["max_queue_depth"]),
             None if quota is None else int(quota),
         )
+        self.survive = SurvivePolicy.from_config(cfg)
+        self.queue.shed_handler = self._on_shed
+        self._health_lock = threading.Lock()
+        self._step_counter = itertools.count(1)  # chaos site step=N
         self._obs_cfg = cfg_lib.resolve_observability(observability)
         self.flight = None
         if self._obs_cfg["flight_recorder"] and out_dir:
@@ -326,8 +422,26 @@ class DecodeEngine:
                    observability=observability)
 
     # -------------------------------------------------------------- gauges --
+    def _event(self, record: dict) -> None:
+        if self.writer is not None:
+            self.writer.write(schema.stamp("event", record))
+
+    def _on_shed(self, request) -> None:
+        """Queue shed callback: one queued decode request expired past its
+        deadline and was dropped before prefill (its future already carries
+        the typed ``deadline_exceeded`` rejection)."""
+        self.stats.record_shed(request.tenant)
+
     def kv_occupancy(self) -> float:
-        return sum(r.cache.occupancy() for r in self.replicas) / len(self.replicas)
+        """Mean KV-pool occupancy across replicas still IN routing. A
+        removed replica's cache is stale garbage (its parked sessions'
+        slots were never freed — probation's rebuild never ran), and
+        counting it would pin the exported gauge high forever, feeding the
+        autoscaler's occupancy rule sustained phantom pressure."""
+        live = [r for r in self.replicas if r.state != "removed"]
+        if not live:
+            return 0.0
+        return sum(r.cache.occupancy() for r in live) / len(live)
 
     def active_sequences(self) -> int:
         return sum(self._active_counts)
@@ -375,6 +489,7 @@ class DecodeEngine:
                     ),
                 },
                 decode=self.decode_meta(),
+                survivability=self.survive.meta(),
                 extra={
                     "api": "serving_decode",
                     "model": self.cfg.get("model"),
@@ -465,10 +580,17 @@ class DecodeEngine:
         temperature: Optional[float] = None,
         seed: int = 0,
         stop_token="default",
+        deadline_s: Optional[float] = None,
     ) -> StreamedResult:
         """Admit one prompt (1-D int token ids). Raises
         :class:`AdmissionError` (bad_shape / oversized / queue_full /
-        tenant_quota / draining) or returns the streaming future."""
+        tenant_quota / draining) or returns the streaming future.
+
+        ``deadline_s``: optional client deadline (seconds from now),
+        combined with the engine's ``request_ttl_s``: a request still
+        QUEUED past the tighter bound is shed with a ``deadline_exceeded``
+        rejection through the future; a sequence that started decoding is
+        NEVER killed by its deadline."""
         tokens = np.asarray(tokens)
         self.stats.record_submit()
         try:
@@ -513,6 +635,9 @@ class DecodeEngine:
                 self.temperature if temperature is None else float(temperature),
                 seed,
                 self.stop_token if stop_token == "default" else stop_token,
+                deadline=survive_lib.admission_deadline(
+                    time.perf_counter(), self.survive.request_ttl_s, deadline_s
+                ),
             )
             self.queue.put(request)
         except AdmissionError as e:
@@ -528,12 +653,14 @@ class DecodeEngine:
         seq.req.result._deliver(np.asarray(seq.out, np.int32))
         self.stats.record_finish(seq.req.tenant)
 
-    def _prefill_one(
+    def _prefill_dispatch(
         self, replica: DecodeReplica, slot: int, req: DecodeRequest
-    ) -> Optional[_Active]:
-        """Prefill one prompt into its slot and sample the first token.
-        Returns the active sequence, or None when it terminated at birth
-        (first sample hit the stop token, or max_new_tokens == 1)."""
+    ):
+        """The ONE prompt-prefill dispatch both the fresh path and the
+        failover-resume path run: bucket the prompt, commit its K/V into
+        the slot, return the last position's logits. Bitwise-critical
+        single source — a resume must prefill exactly as the undisturbed
+        run did, or the continuation guarantee breaks."""
         cache = replica.cache
         n = len(req.tokens)
         P = batching.bucket_for(n, self.max_prompt_len)
@@ -545,6 +672,17 @@ class DecodeEngine:
             jnp.asarray(n, jnp.int32),
         )
         cache.lengths[slot] = n
+        return logits
+
+    def _prefill_one(
+        self, replica: DecodeReplica, slot: int, req: DecodeRequest
+    ) -> Optional[_Active]:
+        """Prefill one prompt into its slot and sample the first token.
+        Returns the active sequence, or None when it terminated at birth
+        (first sample hit the stop token, or max_new_tokens == 1)."""
+        cache = replica.cache
+        n = len(req.tokens)
+        logits = self._prefill_dispatch(replica, slot, req)
         tok = _sample(np.asarray(logits), req.temperature, req.seed, 0)
         if req.stop_token is not None and tok == req.stop_token:
             # terminated before emitting anything: an empty (but successful)
@@ -563,144 +701,362 @@ class DecodeEngine:
             return None
         return seq
 
-    def _recover_pools(
-        self, replica: DecodeReplica, active: Dict[int, "_Active"]
+    def _resume_one(
+        self, replica: DecodeReplica, slot: int, req: DecodeRequest
+    ) -> Optional[_Active]:
+        """Session failover re-admission: continue a sequence whose replica
+        died, **bitwise-equal** to an undisturbed run.
+
+        The journal (``req.resume_tokens``) holds every token already
+        streamed to the client. The original prompt is re-prefilled through
+        the SAME prefill program the undisturbed run used (its sampled
+        logits are discarded — those tokens are known), and the generated
+        prefix is queued for REPLAY through the step program: each replay
+        step re-commits one recorded token's K/V exactly the way the
+        original run committed it, delivering nothing. Every K/V position
+        is therefore rebuilt by the same program kind that wrote it
+        originally, and host sampling is keyed by ``(seed, token index)``
+        alone — so when live decoding resumes at the journal's cursor, the
+        continuation is bitwise the stream the dead replica would have
+        produced."""
+        journal = list(req.resume_tokens)
+        if not journal:
+            # died during prefill, before its first token: a fresh prefill
+            # IS the bitwise resume (token index 0 samples identically)
+            req.resume_tokens = None
+            try:
+                seq = self._prefill_one(replica, slot, req)
+            except BaseException:
+                req.resume_tokens = []  # keep the journal for the next try
+                raise
+            self._record_failover(replica, req, 0)
+            return seq
+        self._prefill_dispatch(replica, slot, req)  # sampled logits
+        # discarded: the journal already knows these tokens
+        req.resume_tokens = None
+        self._record_failover(replica, req, len(journal))
+        seq = _Active(req, slot, journal[0])
+        seq.out = list(journal)
+        seq.n_generated = len(journal)
+        seq.replay = list(journal[1:])
+        return seq
+
+    def _record_failover(
+        self, replica: DecodeReplica, req: DecodeRequest, tokens: int
     ) -> None:
-        """A dispatch that failed AFTER consuming its donated K/V pool
-        buffers (donate_argnums — real on an accelerator, ignored by
-        XLA:CPU) leaves ``replica.kpool/vpool`` bound to deleted arrays, so
-        every later prefill/step on the replica would raise forever. Probe
-        for that and rebuild from empty pools; any KV state the surviving
-        sequences held lived in the lost buffers, so they are failed too."""
-        try:
-            poisoned = (
-                replica.kpool.is_deleted() or replica.vpool.is_deleted()
-            )
-        except Exception:  # noqa: BLE001 — treat an unprobeable pool as lost
-            poisoned = True
-        if not poisoned:
-            return
-        cache = replica.cache
-        err = RuntimeError(
-            f"decode replica {replica.index}: KV pools consumed by a failed "
-            "donated dispatch; in-flight sequences reset"
+        self.stats.record_failover(req.tenant)
+        self._event({
+            "event": "session_failover",
+            "request": req.id,
+            "tenant": req.tenant,
+            "from_replica": req.failed_from,
+            "to_replica": replica.index,
+            "tokens_generated": tokens,
+        })
+        logger.warning(
+            "decode: session %d (tenant %s) failed over from replica %s to "
+            "%d with %d token(s) journaled",
+            req.id, req.tenant, req.failed_from, replica.index, tokens,
         )
-        for seq in list(active.values()):
-            cache.free(seq.slot)
-            seq.req.result._deliver(None, error=err)
+
+    def _replica_incident(
+        self,
+        replica: DecodeReplica,
+        pending: List[DecodeRequest],
+        active: Dict[int, "_Active"],
+        error: BaseException,
+    ) -> bool:
+        """A dispatch on ``replica`` died (step/prefill raised — possibly
+        after consuming the donated K/V pools). Park every live session
+        into its failover journal and re-queue it at lane front (immune to
+        deadline shedding and the closed flag — a draining engine still
+        owes its streams), return untouched pending work to the shared
+        queue, then run one probation episode (rebuild pools + canary,
+        jittered backoff, bounded by the policy). True = the replica
+        recovered and rejoins routing; False = it is permanently removed
+        (the caller decides between exiting to surviving peers and the
+        typed no-healthy-replica fallback).
+
+        Attribution: a place-phase failure tags its CULPRIT on the
+        exception. Only the culprit is charged a failover episode (the
+        poisoned-request firewall — innocent sessions parked by someone
+        else's incident ride free), and a culprit-attributed incident
+        whose canary then passes does not charge the replica's lifetime
+        ``max_recoveries`` budget either: the device was provably never
+        the problem. Unattributed (step) failures are device evidence —
+        they charge the replica, and park every session for free."""
+        culprit = getattr(error, "_tpuddp_culprit", None)
+        logger.exception(
+            "decode: dispatch failed on replica %d; parking %d session(s), "
+            "returning %d pending request(s)",
+            replica.index, len(active), len(pending),
+        )
+        with self._health_lock:
+            replica.state = "recovering"
+        self._event({
+            "event": "replica_unhealthy",
+            "replica": replica.index,
+            "error": repr(error),
+            "sessions": len(active),
+        })
+        # requeue is appendleft: push pending in reverse to preserve FIFO,
+        # then the journals, so live sessions land ahead of untouched work
+        for req in reversed(pending):
+            if req is culprit and not self._park(req, error):
+                continue
+            self.queue.requeue(req)
+        pending.clear()
+        for slot in sorted(active.keys(), reverse=True):
+            seq = active[slot]
+            seq.req.resume_tokens = list(seq.out)
+            seq.req.failed_from = replica.index
+            self.queue.requeue(seq.req)
         active.clear()
         self._active_counts[replica.index] = 0
-        shape = cache.pool_shape()
-        replica.kpool = jax.device_put(
-            jnp.zeros(shape, jnp.float32), replica.device
+
+        def recover():
+            replica.rebuild()
+            replica.canary(self.buckets)
+
+        ok, event = survive_lib.probation_episode(
+            replica,
+            name=f"decode replica {replica.index}",
+            recover=recover,
+            policy=self.survive,
+            count_recovery=culprit is None,
+            lock=self._health_lock,
         )
-        replica.vpool = jax.device_put(
-            jnp.zeros(shape, jnp.float32), replica.device
+        self._event(event)
+        return ok
+
+    def _park(self, req: DecodeRequest, error: BaseException) -> bool:
+        """Charge one failover episode to the CULPRIT of a place-phase
+        incident. True = within the budget (the caller journals + requeues
+        it); False = the budget is spent — the request is failed through
+        with the dispatch error (delivered here) instead of re-parked, so
+        a request whose own content kills any dispatch cannot ride its
+        journal around the pool forever."""
+        req.failovers += 1
+        if req.failovers <= self.survive.max_failovers:
+            return True
+        logger.error(
+            "decode: session %d (tenant %s) exceeded max_failovers=%d — "
+            "failing it with the dispatch error instead of re-parking "
+            "(poisoned-request firewall)",
+            req.id, req.tenant, self.survive.max_failovers,
         )
-        logger.warning(
-            "decode: replica %d KV pools rebuilt after a failed donated "
-            "dispatch", replica.index,
+        req.result._deliver(None, error=error)
+        return False
+
+    def _shed_expired_pending(self, pending: List[DecodeRequest]) -> None:
+        """Deadline shedding for the loop's private pending list: a pulled-
+        but-never-prefilled request is still queued work. Journals
+        (in-flight sessions mid-migration) are exempt."""
+        if not pending:
+            return
+        now = time.perf_counter()
+        keep = []
+        for req in pending:
+            if (
+                req.resume_tokens is None
+                and req.deadline is not None
+                and now > req.deadline
+            ):
+                self.queue._deliver_shed(req)
+            else:
+                keep.append(req)
+        pending[:] = keep
+
+    def _serve_once(
+        self,
+        replica: DecodeReplica,
+        pending: List[DecodeRequest],
+        active: Dict[int, "_Active"],
+    ) -> bool:
+        """One admit -> place -> step -> deliver iteration. True = the
+        queue is closed and fully drained (the loop's exit signal). Any
+        dispatch failure raises to the caller's incident handler."""
+        cache = replica.cache
+        S = cache.max_slots
+        self._shed_expired_pending(pending)
+        # -- admit: pull queued requests round-robin into free capacity.
+        # Capacity counts BLOCKS as well as slots, at worst-case lifetime
+        # budget (max_blocks per sequence): a block-starved replica must
+        # not pull work into its private pending list that an idle
+        # sibling could place immediately — requests it cannot yet hold
+        # stay in the shared queue where any replica can take them.
+        capacity = min(
+            cache.free_slots, cache.free_blocks // cache.max_blocks
         )
+        if not active and not pending:
+            group = self.queue.take_group(max(1, capacity), wait=True)
+            if group is None:
+                return True
+        else:
+            room = capacity - len(pending)
+            group = (
+                self.queue.take_group(room, wait=False) if room > 0 else []
+            )
+            group = group or []  # None (closed) -> finish what we hold
+        pending.extend(group)
+        # -- place: strict arrival order; stop at the first request the
+        # pool cannot hold yet, so nobody is starved by a smaller
+        # latecomer jumping the block queue
+        while pending and cache.can_admit(pending[0].total_tokens):
+            req = pending.pop(0)
+            slot = cache.allocate(req.total_tokens)
+            try:
+                if req.resume_tokens is not None:
+                    seq = self._resume_one(replica, slot, req)
+                else:
+                    seq = self._prefill_one(replica, slot, req)
+            except BaseException as e:
+                # the request mid-prefill becomes a live session with an
+                # empty journal (it was admitted and dispatched); put it
+                # back at the head so the incident handler parks it. Tag
+                # it as the incident's CULPRIT: a place-phase failure is
+                # attributable to the one request being placed, and only
+                # the culprit is charged a failover episode (innocent
+                # parked sessions ride free) or can spare the replica's
+                # lifetime probation budget.
+                if req.resume_tokens is None:
+                    req.resume_tokens = []
+                pending.insert(0, req)
+                try:
+                    e._tpuddp_culprit = req
+                except Exception:  # noqa: BLE001 — exotic exception types
+                    pass
+                raise
+            if seq is not None:
+                active[seq.slot] = seq
+        self._active_counts[replica.index] = len(active)
+        if not active:
+            if pending or not self.queue.closed:
+                return False
+            if self.queue.depth() == 0:
+                return True
+            return False
+        # -- step: the one fixed-shape (max_slots, 1) program
+        tokens = np.zeros((S,), np.int32)
+        for slot, seq in active.items():
+            tokens[slot] = seq.last_token
+        kind = faults.maybe_serving_fault(
+            "step", step=next(self._step_counter)
+        )
+        if kind == "replica_kill":
+            replica.broken = True  # persistent until rebuild()
+        if kind == "pool_poison":
+            # the donated-buffer death: the pools are gone mid-sweep
+            replica.kpool.delete()
+            replica.vpool.delete()
+            raise RuntimeError("injected pool_poison fault: KV pools lost")
+        if kind == "dispatch_wedge":
+            raise RuntimeError("injected dispatch_wedge fault (transient)")
+        replica.check_broken()
+        logits, replica.kpool, replica.vpool = replica._step(
+            replica.params, replica.kpool, replica.vpool,
+            jnp.asarray(cache.tables), jnp.asarray(cache.lengths),
+            jnp.asarray(tokens),
+        )
+        logits = np.asarray(logits)  # fetch = fence
+        replica.steps += 1
+        now = time.perf_counter()
+        for slot, seq in list(active.items()):
+            cache.lengths[slot] += 1  # the step committed last_token's KV
+            if seq.replay:
+                # failover replay: the step re-committed a recorded token's
+                # K/V; the client already has every replayed token, so
+                # nothing is sampled, delivered, or counted
+                seq.last_token = seq.replay.pop(0)
+                seq.t_last = now
+                continue
+            tok = _sample(
+                logits[slot], seq.req.temperature, seq.req.seed,
+                seq.n_generated,
+            )
+            if seq.req.stop_token is not None and tok == seq.req.stop_token:
+                del active[slot]
+                self._finish(cache, seq)
+                continue
+            seq.out.append(tok)
+            seq.n_generated += 1
+            seq.req.result._deliver_token(tok)
+            self.stats.record_token((now - seq.t_last) * 1e3)
+            seq.t_last = now
+            seq.last_token = tok
+            if seq.n_generated >= seq.req.max_new_tokens:
+                del active[slot]
+                self._finish(cache, seq)
+        self._active_counts[replica.index] = len(active)
+        return False
 
     def _decode_loop(self, replica: DecodeReplica) -> None:
         """One replica's life: admit -> prefill -> step -> deliver -> retire,
         every iteration. Exits when the queue closes and drains AND every
         in-flight sequence has terminated (the drain contract: SIGTERM never
-        truncates a stream). A failed prefill rejects only its own request;
-        a failed step fails the sequences that were in flight on this
-        replica (their streams raise), frees their slots, and the loop keeps
-        serving — the request engine's failure-isolation contract."""
-        cache = replica.cache
+        truncates a stream).
+
+        Survivability: a failed dispatch no longer kills its streams — the
+        incident handler parks every live session into a failover journal
+        (re-queued at lane front for ANY replica to resume bitwise) and
+        runs probation on this replica. Recovered -> rejoin; removed with
+        surviving peers -> this thread exits and the peers own the
+        journals; removed as the LAST replica -> queued and parked work
+        fails with the typed ``no_healthy_replica`` reason and the loop
+        keeps failing new arrivals fast until drain — never a hang."""
         pending: List[DecodeRequest] = []
         active: Dict[int, _Active] = {}
-        S = cache.max_slots
+        replica.loop_alive = True
+        try:
+            self._decode_loop_body(replica, pending, active)
+        finally:
+            replica.loop_alive = False
+
+    def _decode_loop_body(
+        self,
+        replica: DecodeReplica,
+        pending: List[DecodeRequest],
+        active: Dict[int, "_Active"],
+    ) -> None:
         while True:
-            # -- admit: pull queued requests round-robin into free capacity.
-            # Capacity counts BLOCKS as well as slots, at worst-case lifetime
-            # budget (max_blocks per sequence): a block-starved replica must
-            # not pull work into its private pending list that an idle
-            # sibling could place immediately — requests it cannot yet hold
-            # stay in the shared queue where any replica can take them.
-            capacity = min(
-                cache.free_slots, cache.free_blocks // cache.max_blocks
-            )
-            if not active and not pending:
-                group = self.queue.take_group(max(1, capacity), wait=True)
+            if replica.state == "removed":
+                # mortuary mode: no servable replica remains and the
+                # recovery round already failed — fail queued work fast
+                # with the machine-readable terminal reason
+                group = self.queue.take_group(1, wait=True)
                 if group is None:
                     return
-            else:
-                room = capacity - len(pending)
-                group = (
-                    self.queue.take_group(room, wait=False) if room > 0 else []
+                err = NoHealthyReplicaError(
+                    "all decode replicas removed after failed recovery"
                 )
-                group = group or []  # None (closed) -> finish what we hold
-            pending.extend(group)
-            # -- place: strict arrival order; stop at the first request the
-            # pool cannot hold yet, so nobody is starved by a smaller
-            # latecomer jumping the block queue
-            while pending and cache.can_admit(pending[0].total_tokens):
-                req = pending.pop(0)
-                slot = cache.allocate(req.total_tokens)
-                try:
-                    seq = self._prefill_one(replica, slot, req)
-                except BaseException as e:  # noqa: BLE001 — delivered to the client
-                    logger.exception(
-                        "decode: prefill failed on replica %d", replica.index
-                    )
-                    cache.free(slot)
-                    req.result._deliver(None, error=e)
-                    self._recover_pools(replica, active)
-                    continue
-                if seq is not None:
-                    active[seq.slot] = seq
-            self._active_counts[replica.index] = len(active)
-            if not active:
-                if pending or not self.queue.closed:
-                    continue
-                if self.queue.depth() == 0:
-                    return
+                for req in group:
+                    req.result._deliver(None, error=err)
                 continue
-            # -- step: the one fixed-shape (max_slots, 1) program
-            tokens = np.zeros((S,), np.int32)
-            for slot, seq in active.items():
-                tokens[slot] = seq.last_token
             try:
-                logits, replica.kpool, replica.vpool = replica._step(
-                    replica.params, replica.kpool, replica.vpool,
-                    jnp.asarray(cache.tables), jnp.asarray(cache.lengths),
-                    jnp.asarray(tokens),
+                if self._serve_once(replica, pending, active):
+                    return
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — the incident path
+                if self._replica_incident(replica, pending, active, e):
+                    continue  # recovered; rejoin routing
+                with self._health_lock:
+                    survivors = survive_lib.live_survivors(
+                        self.replicas, replica
+                    )
+                if survivors:
+                    return  # peers own the journals; this thread is done
+                logger.critical(
+                    "decode: NO healthy replicas remain after the recovery "
+                    "round; failing queued work with reason "
+                    "no_healthy_replica instead of hanging"
                 )
-                logits = np.asarray(logits)  # fetch = fence
-            except BaseException as e:  # noqa: BLE001
-                logger.exception(
-                    "decode: step failed on replica %d", replica.index
-                )
-                for seq in list(active.values()):
-                    cache.free(seq.slot)
-                    seq.req.result._deliver(None, error=e)
-                active.clear()
-                self._active_counts[replica.index] = 0
-                self._recover_pools(replica, active)
-                continue
-            replica.steps += 1
-            now = time.perf_counter()
-            for slot, seq in list(active.items()):
-                cache.lengths[slot] += 1  # the step committed last_token's KV
-                tok = _sample(
-                    logits[slot], seq.req.temperature, seq.req.seed,
-                    seq.n_generated,
-                )
-                if seq.req.stop_token is not None and tok == seq.req.stop_token:
-                    del active[slot]
-                    self._finish(cache, seq)
-                    continue
-                seq.out.append(tok)
-                seq.n_generated += 1
-                seq.req.result._deliver_token(tok)
-                self.stats.record_token((now - seq.t_last) * 1e3)
-                seq.t_last = now
-                seq.last_token = tok
-                if seq.n_generated >= seq.req.max_new_tokens:
-                    del active[slot]
-                    self._finish(cache, seq)
-            self._active_counts[replica.index] = len(active)
+                self._event({
+                    "event": "no_healthy_replica",
+                    "replica": replica.index,
+                })
+                if self.flight is not None:
+                    # decode dispatch death: the last windows + the
+                    # unhealthy/removed events are in the ring
+                    self.flight.dump("serving_dispatch")
+                continue  # -> mortuary branch

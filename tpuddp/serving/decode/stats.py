@@ -66,6 +66,11 @@ class DecodeStats:
         self.prompt_tokens = 0
         self.rejects = Counter()
         self.per_tenant_completed = Counter()
+        # survivability accounting (tpuddp/serving/survive.py): queued
+        # requests shed past their deadline, and live sessions migrated
+        # off a dead replica (their streams continued bitwise elsewhere)
+        self.shed = 0
+        self.failovers = 0
         self._ttft_ms: list = []
         self._itl_ms: list = []
         self._lat_dropped = 0
@@ -74,7 +79,10 @@ class DecodeStats:
         self._win_itl: list = []
         self._win_index = 0
         self._win_t0 = self._t0
-        self._win_start = dict(tokens=0, completed=0, submitted=0, rejected=0)
+        self._win_start = dict(
+            tokens=0, completed=0, submitted=0, rejected=0, shed=0,
+            failovers=0,
+        )
         self.last_window: Optional[dict] = None
 
     # ------------------------------------------------------------ recording --
@@ -93,6 +101,20 @@ class DecodeStats:
     def record_reject(self, tenant: str, reason: str) -> None:
         with self._lock:
             self.rejects[reason] += 1
+
+    def record_shed(self, tenant: str) -> None:
+        """One queued request dropped past its deadline (load shedding) —
+        a rejection with reason ``deadline_exceeded`` plus the dedicated
+        shed counter the autoscaler's shed-rate rule scrapes."""
+        with self._lock:
+            self.rejects["deadline_exceeded"] += 1
+            self.shed += 1
+
+    def record_failover(self, tenant: str) -> None:
+        """One live session migrated off a dead replica (its stream
+        continues bitwise on the new one)."""
+        with self._lock:
+            self.failovers += 1
 
     def record_first_token(self, ttft_ms: float, prompt_tokens: int) -> None:
         """The prefill's sampled token delivered — TTFT's clock stops."""
@@ -151,6 +173,9 @@ class DecodeStats:
                if k in ("p50", "p95", "p99")},
             "kv_occupancy": None if kv_occ is None else round(kv_occ, 4),
             "active_sequences": active,
+            # survivability accounting (required at schema v7)
+            "shed": self.shed - self._win_start["shed"],
+            "failovers": self.failovers - self._win_start["failovers"],
         }
         if self.writer is not None:
             self.writer.write(schema.stamp("decode_stats", record))
@@ -164,6 +189,8 @@ class DecodeStats:
             completed=self.completed,
             submitted=self.submitted,
             rejected=sum(self.rejects.values()),
+            shed=self.shed,
+            failovers=self.failovers,
         )
         return record
 
@@ -174,6 +201,7 @@ class DecodeStats:
                 self.tokens == self._win_start["tokens"]
                 and self.submitted == self._win_start["submitted"]
                 and sum(self.rejects.values()) == self._win_start["rejected"]
+                and self.failovers == self._win_start["failovers"]
             ):
                 return None
             return self._emit_window()
@@ -187,6 +215,8 @@ class DecodeStats:
                 completed=self.completed,
                 submitted=self.submitted,
                 rejected=sum(self.rejects.values()),
+                shed=self.shed,
+                failovers=self.failovers,
                 ttft_samples=len(self._ttft_ms),
                 itl_samples=len(self._itl_ms),
                 dropped=self._lat_dropped,
@@ -202,6 +232,8 @@ class DecodeStats:
                 "completed": self.completed - mark["completed"],
                 "submitted": self.submitted - mark["submitted"],
                 "rejected": sum(self.rejects.values()) - mark["rejected"],
+                "shed": self.shed - mark.get("shed", 0),
+                "failovers": self.failovers - mark.get("failovers", 0),
                 "tokens_per_sec": round(tokens / wall, 2),
                 "ttft_ms": _pct_ms(self._ttft_ms[mark["ttft_samples"]:]),
                 "itl_ms": _pct_ms(self._itl_ms[mark["itl_samples"]:]),
@@ -225,6 +257,8 @@ class DecodeStats:
                 completed = self.completed
                 submitted = self.submitted
                 rejected = sum(self.rejects.values())
+                shed = self.shed
+                failovers = self.failovers
                 win = dict(self.last_window) if self.last_window else None
             series = {
                 "decode_tokens_total": exp.counter(
@@ -238,6 +272,16 @@ class DecodeStats:
                 ),
                 "decode_rejected_total": exp.counter(
                     rejected, "decode requests rejected at admission"
+                ),
+                # survivability counters (tpuddp/serving/survive.py) — the
+                # autoscaler's shed-rate rule reads decode_shed_total on
+                # decode jobs the way it reads serving_shed_total
+                "decode_shed_total": exp.counter(
+                    shed, "queued decode requests shed past their deadline"
+                ),
+                "decode_session_failovers_total": exp.counter(
+                    failovers,
+                    "live sessions migrated off a dead replica",
                 ),
             }
             if win is not None:
@@ -274,6 +318,14 @@ class DecodeStats:
                 series["decode_queue_depth"] = exp.gauge(
                     engine.queue.depth(), "decode requests queued right now"
                 )
+                series["decode_replicas_healthy"] = exp.gauge(
+                    sum(1 for r in engine.replicas if r.healthy),
+                    "decode replicas still routed to",
+                )
+                series["decode_replica_recoveries_total"] = exp.counter(
+                    sum(r.recoveries for r in engine.replicas),
+                    "probation episodes passed (replicas rejoined routing)",
+                )
             return series
 
         return source
@@ -288,6 +340,8 @@ class DecodeStats:
                 "tokens": self.tokens,
                 "prompt_tokens": self.prompt_tokens,
                 "rejected": dict(self.rejects),
+                "shed": self.shed,
+                "failovers": self.failovers,
                 "per_tenant_completed": dict(self.per_tenant_completed),
                 "tokens_per_sec": round(self.tokens / wall, 2),
                 "ttft_ms": _pct_ms(self._ttft_ms),

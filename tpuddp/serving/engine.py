@@ -18,6 +18,7 @@ draining server exactly like a draining trainer.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
@@ -26,11 +27,14 @@ from typing import List, Optional
 import numpy as np
 
 from tpuddp.observability import MetricsWriter, schema
+from tpuddp.resilience import faults
 from tpuddp.serving import queue as queue_mod
+from tpuddp.serving import survive as survive_lib
 from tpuddp.serving.queue import AdmissionError, Request, RequestQueue, ServedResult
 from tpuddp.serving.replica import Replica, ReplicaPool
 from tpuddp.serving.scheduler import BatchScheduler
 from tpuddp.serving.stats import ServingStats
+from tpuddp.serving.survive import NoHealthyReplicaError, SurvivePolicy
 
 logger = logging.getLogger("tpuddp")
 
@@ -50,13 +54,22 @@ class ServingEngine:
         config: Optional[dict] = None,
         unhealthy_after: int = 3,
         observability: Optional[dict] = None,
+        survive: Optional[SurvivePolicy] = None,
     ):
         """``unhealthy_after``: K consecutive dispatch errors mark a replica
-        unhealthy — its loop stops pulling work (a broken device/program no
-        longer fails every batch routed to it) and a ``replica_unhealthy``
-        event row lands in history.jsonl; healthy replicas keep serving and
-        drain still exits cleanly. 0 disables the marking (legacy behavior:
-        each batch on the broken replica fails individually, forever).
+        unhealthy — it leaves routing and enters probation (see ``survive``)
+        while a ``replica_unhealthy`` event row lands in history.jsonl;
+        healthy replicas keep serving and drain still exits cleanly. 0
+        disables the marking (legacy behavior: each batch on the broken
+        replica fails individually, forever).
+
+        ``survive``: the survivability policy
+        (:class:`~tpuddp.serving.survive.SurvivePolicy`): probation/recovery
+        bounds for unhealthy replicas (jittered-backoff rebuild + canary,
+        ``max_recoveries`` lifetime rejoins, permanent removal as the
+        fallback), the admission-time request TTL, and the per-tenant
+        transient-dispatch retry budget. None -> defaults (recovery on,
+        TTL and retries off).
 
         ``observability``: the live-plane block (config.OBSERVABILITY_DEFAULTS
         shape): ``exporter: true`` serves /metrics from the SLO stats (last
@@ -74,6 +87,11 @@ class ServingEngine:
             self.queue, max_batch_size, batch_timeout_ms
         )
         self.unhealthy_after = int(unhealthy_after or 0)
+        self.survive = survive or SurvivePolicy()
+        self.retry_budget = survive_lib.RetryBudget(self.survive.retry_budget)
+        self.queue.shed_handler = self._on_shed
+        self._health_lock = threading.Lock()
+        self._batch_counter = itertools.count(1)  # chaos site batch=N
         self._obs_cfg = cfg_lib.resolve_observability(observability)
         self.flight = None
         if self._obs_cfg["flight_recorder"] and out_dir:
@@ -113,6 +131,7 @@ class ServingEngine:
             config=cfg,
             unhealthy_after=int(cfg.get("unhealthy_after", 3) or 0),
             observability=observability,
+            survive=SurvivePolicy.from_config(cfg),
         )
 
     # ------------------------------------------------------------- lifecycle --
@@ -145,6 +164,7 @@ class ServingEngine:
                             else False
                         ),
                     },
+                    survivability=self.survive.meta(),
                     extra={
                         "api": "serving",
                         "model": cfg.get("model"),
@@ -233,10 +253,29 @@ class ServingEngine:
         return self.stats.summary()
 
     # --------------------------------------------------------------- client --
-    def submit(self, tenant: str, x: np.ndarray) -> ServedResult:
+    def _on_shed(self, request) -> None:
+        """Queue shed callback: one queued request expired past its deadline
+        and was dropped before dispatch (its future already carries the
+        typed ``deadline_exceeded`` rejection). A shed request LEAVES the
+        system — any retry tokens it consumed while bouncing off a failed
+        dispatch are refunded, like every other exit path."""
+        if getattr(request, "retries", 0):
+            self.retry_budget.refund(request.tenant, request.retries)
+            request.retries = 0
+        self.stats.record_shed(request.tenant)
+
+    def submit(
+        self, tenant: str, x: np.ndarray, deadline_s: Optional[float] = None
+    ) -> ServedResult:
         """Admit one request of ``(rows, *sample_shape)`` float32 rows.
         Raises :class:`AdmissionError` (reason queue_full / tenant_quota /
-        draining / oversized / bad_shape) or returns the result future."""
+        draining / oversized / bad_shape) or returns the result future.
+
+        ``deadline_s``: optional client deadline (seconds from now). The
+        effective deadline is the tighter of it and the engine's
+        ``request_ttl_s``; a request still QUEUED past it is shed with a
+        ``deadline_exceeded`` rejection delivered through the future —
+        work already dispatched always completes."""
         x = np.asarray(x)
         self.stats.record_submit()
         try:
@@ -265,7 +304,13 @@ class ServingEngine:
                 )
             # own the rows: a client reusing (mutating) its submit buffer
             # must not rewrite a still-queued request's inputs
-            request = Request(tenant, np.array(x, copy=True))
+            request = Request(
+                tenant,
+                np.array(x, copy=True),
+                deadline=survive_lib.admission_deadline(
+                    time.perf_counter(), self.survive.request_ttl_s, deadline_s
+                ),
+            )
             self.queue.put(request)
         except AdmissionError as e:
             self.stats.record_reject(tenant, e.reason)
@@ -273,89 +318,169 @@ class ServingEngine:
         return request.result
 
     # -------------------------------------------------------------- dispatch --
+    def _event(self, record: dict) -> None:
+        if self.writer is not None:
+            self.writer.write(schema.stamp("event", record))
+
     def _dispatch_loop(self, replica: Replica) -> None:
         """One replica's life: pull, dispatch, deliver, repeat — exits when
-        the queue closes and drains. A failed dispatch fails its batch's
-        requests (never the loop): clients see the exception through their
-        future, the next batch proceeds. ``unhealthy_after`` consecutive
-        failures mark the replica unhealthy: with healthy peers remaining,
-        this loop simply stops pulling (traffic continues on the peers);
-        when it was the LAST healthy replica, the loop keeps pulling and
-        fails batches immediately so queued clients get errors instead of a
-        hung drain."""
+        the queue closes and drains. A failed dispatch retries its batch's
+        requests within the per-tenant retry budget (they re-enter the
+        queue and another — or the recovered — replica serves them) and
+        fails the rest through their futures; the loop itself never dies on
+        a dispatch. ``unhealthy_after`` consecutive failures put the
+        replica on PROBATION: a bounded jittered-backoff recovery loop
+        (rebuild + re-warm + canary) runs here, off the serving path, and
+        the replica rejoins routing only after the canary passes
+        (``replica_recovered`` event). Probation exhausted -> permanent
+        removal: with surviving peers this thread exits (traffic continues
+        on them); as the LAST replica, after that one recovery round, the
+        loop keeps pulling and fails everything with the typed
+        ``no_healthy_replica`` reason — queued clients get machine-readable
+        errors, never a hung drain."""
+        replica.loop_alive = True
+        try:
+            self._dispatch_loop_body(replica)
+        finally:
+            replica.loop_alive = False
+
+    def _dispatch_loop_body(self, replica: Replica) -> None:
         while True:
             batch = self.scheduler.next_batch()
             if batch is None:
                 return
-            if not replica.healthy:
-                # only reachable when no healthy replica remains (see below)
-                err = RuntimeError(
-                    f"serving: replica {replica.index} is unhealthy and no "
-                    "healthy replicas remain"
+            if replica.state == "removed":
+                # mortuary mode — only reachable when no servable replica
+                # remains and the recovery round already failed
+                err = NoHealthyReplicaError(
+                    f"replica {replica.index} is removed and no healthy "
+                    "replicas remain"
                 )
                 for r in batch.requests:
-                    r.result._deliver(None, error=err)
+                    self._fail_request(r, err)
                 continue
             t_dispatch = time.perf_counter()
             try:
-                logits = np.asarray(replica.infer(batch.x))  # fetch = fence
-            except BaseException as e:  # noqa: BLE001 — delivered to clients
-                logger.exception(
-                    "serving: dispatch failed on replica %d", replica.index
+                kind = faults.maybe_serving_fault(
+                    "batch", batch=next(self._batch_counter)
                 )
-                replica.consecutive_errors += 1
-                for r in batch.requests:
-                    r.result._deliver(None, error=e)
-                if self.writer is not None:
-                    self.writer.write(
-                        schema.stamp(
-                            "event",
-                            {
-                                "event": "serving_dispatch_error",
-                                "replica": replica.index,
-                                "error": repr(e),
-                                "requests": len(batch.requests),
-                            },
+                if kind == "replica_kill":
+                    replica.broken = True  # persistent until rebuild()
+                if kind == "dispatch_wedge":
+                    raise RuntimeError(
+                        "injected dispatch_wedge fault (transient)"
+                    )
+                logits = np.asarray(replica.infer(batch.x))  # fetch = fence
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — retried or delivered
+                self._dispatch_failed(replica, batch, e)
+                if replica.state == "recovering" and not self._probation(replica):
+                    with self._health_lock:
+                        survivors = survive_lib.live_survivors(
+                            self.pool.replicas, replica
                         )
-                    )
-                if (
-                    self.unhealthy_after
-                    and replica.healthy
-                    and replica.consecutive_errors >= self.unhealthy_after
-                ):
-                    replica.healthy = False
+                    if survivors:
+                        return  # peers own the traffic; this thread is done
                     logger.critical(
-                        "serving: replica %d marked UNHEALTHY after %d "
-                        "consecutive dispatch errors; routing stops",
-                        replica.index, replica.consecutive_errors,
+                        "serving: NO healthy replicas remain after the "
+                        "recovery round; failing queued requests with "
+                        "reason no_healthy_replica instead of hanging"
                     )
-                    if self.writer is not None:
-                        self.writer.write(
-                            schema.stamp(
-                                "event",
-                                {
-                                    "event": "replica_unhealthy",
-                                    "replica": replica.index,
-                                    "consecutive_errors":
-                                        replica.consecutive_errors,
-                                },
-                            )
-                        )
-                    if any(r.healthy for r in self.pool.replicas):
-                        return  # healthy peers keep serving; stop routing here
-                    logger.critical(
-                        "serving: NO healthy replicas remain; failing queued "
-                        "requests instead of hanging the drain"
-                    )
+                    self._event({
+                        "event": "no_healthy_replica",
+                        "replica": replica.index,
+                    })
                     if self.flight is not None:
                         # serving dispatch death: the last windows + the
                         # dispatch-error/unhealthy events are in the ring
                         self.flight.dump("serving_dispatch")
                 continue
             replica.consecutive_errors = 0
+            for r in batch.requests:
+                if r.retries:
+                    # a retried request made it: return its tokens so a
+                    # transient blip never permanently drains the tenant
+                    self.retry_budget.refund(r.tenant, r.retries)
+                    r.retries = 0
             t_done = time.perf_counter()
             for r, (lo, hi) in zip(batch.requests, batch.slices):
                 # copy, don't view: a view would pin the whole padded
                 # bucket's logits per result and alias clients to each other
                 r.result._deliver(logits[lo:hi].copy())
             self.stats.record_batch(batch, t_dispatch, t_done)
+
+    def _fail_request(self, r, error: BaseException) -> None:
+        """Fail one request through its future — refunding any retry
+        tokens it consumed first: the budget bounds retries PER REQUEST,
+        and a request leaving the system (success or failure alike) must
+        not drain the tenant's budget for unrelated future work."""
+        if r.retries:
+            self.retry_budget.refund(r.tenant, r.retries)
+            r.retries = 0
+        r.result._deliver(None, error=error)
+
+    def _dispatch_failed(self, replica: Replica, batch, e: BaseException) -> None:
+        """One failed dispatch: retry the batch's requests within the
+        per-tenant budget (re-queued at lane front; any replica may pick
+        them up), fail the rest, and cross into probation at the
+        ``unhealthy_after`` threshold."""
+        logger.exception(
+            "serving: dispatch failed on replica %d", replica.index
+        )
+        replica.consecutive_errors += 1
+        retried = 0
+        for r in batch.requests:
+            if self.retry_budget.try_consume(r.tenant):
+                r.retries += 1
+                retried += 1
+                self.stats.record_retry(r.tenant)
+                self.queue.requeue(r)
+            else:
+                self._fail_request(r, e)
+        self._event({
+            "event": "serving_dispatch_error",
+            "replica": replica.index,
+            "error": repr(e),
+            "requests": len(batch.requests),
+            "retried": retried,
+        })
+        if (
+            self.unhealthy_after
+            and replica.state == "healthy"
+            and replica.consecutive_errors >= self.unhealthy_after
+        ):
+            replica.state = "recovering"
+            logger.critical(
+                "serving: replica %d marked UNHEALTHY after %d consecutive "
+                "dispatch errors; entering probation",
+                replica.index, replica.consecutive_errors,
+            )
+            self._event({
+                "event": "replica_unhealthy",
+                "replica": replica.index,
+                "consecutive_errors": replica.consecutive_errors,
+            })
+
+    def _probation(self, replica: Replica) -> bool:
+        """One probation episode for an unhealthy replica. True = it passed
+        (rebuilt, re-warmed, canary served finite logits) and rejoined
+        routing; False = it is permanently removed (``max_recoveries``
+        lifetime episodes spent, or every in-episode attempt failed)."""
+
+        def recover():
+            replica.rebuild()
+            replica.warmup(self.scheduler.buckets, self.pool.sample_shape)
+            replica.canary(self.pool.sample_shape)
+
+        ok, event = survive_lib.probation_episode(
+            replica,
+            name=f"serving replica {replica.index}",
+            recover=recover,
+            policy=self.survive,
+            lock=self._health_lock,
+        )
+        if ok:
+            replica.consecutive_errors = 0
+        self._event(event)
+        return ok

@@ -35,6 +35,9 @@ REJECT_TENANT_QUOTA = "tenant_quota"  # this tenant's quota reached
 REJECT_DRAINING = "draining"  # engine is shutting down; no new admissions
 REJECT_OVERSIZED = "oversized"  # more rows than max_batch_size can ever hold
 REJECT_BAD_SHAPE = "bad_shape"  # sample shape/dtype != the served model's
+REJECT_DEADLINE = "deadline_exceeded"  # queued past its deadline; shed
+# before dispatch (load shedding, tpuddp/serving/survive.py) — work already
+# IN FLIGHT is never killed by a deadline
 
 REJECT_REASONS = (
     REJECT_QUEUE_FULL,
@@ -42,6 +45,7 @@ REJECT_REASONS = (
     REJECT_DRAINING,
     REJECT_OVERSIZED,
     REJECT_BAD_SHAPE,
+    REJECT_DEADLINE,
 )
 
 
@@ -94,11 +98,20 @@ class Request:
     """One admitted inference request: ``x`` is a ``(rows, *sample_shape)``
     host batch (rows >= 1, variable per request); results arrive on
     ``result``. ``key`` buckets by per-SAMPLE shape+dtype (rows concatenate
-    across requests, so the batch axis is not part of the key)."""
+    across requests, so the batch axis is not part of the key).
 
-    __slots__ = ("id", "tenant", "x", "rows", "key", "t_enqueue", "result")
+    ``deadline`` (absolute perf_counter seconds, or None) arms load
+    shedding: a request still queued past it is shed with reason
+    ``deadline_exceeded`` instead of dispatched. ``retries`` counts how
+    many times a transient dispatch failure re-queued this request (the
+    per-tenant :class:`~tpuddp.serving.survive.RetryBudget` bounds it)."""
 
-    def __init__(self, tenant: str, x: np.ndarray):
+    __slots__ = (
+        "id", "tenant", "x", "rows", "key", "t_enqueue", "result",
+        "deadline", "retries", "resume_tokens",
+    )
+
+    def __init__(self, tenant: str, x: np.ndarray, deadline: Optional[float] = None):
         self.id = next(_ids)
         self.tenant = str(tenant)
         self.x = x
@@ -106,6 +119,11 @@ class Request:
         self.key = (batching.shape_key(x)[0][1:], str(x.dtype))
         self.t_enqueue = time.perf_counter()
         self.result = ServedResult()
+        self.deadline = deadline
+        self.retries = 0
+        # non-None marks a failover journal (a live session mid-migration,
+        # decode engine); journals are in-flight work and are never shed
+        self.resume_tokens = None
 
 
 class RequestQueue:
@@ -134,6 +152,13 @@ class RequestQueue:
         self._depth = 0
         self._closed = False
         self._cond = threading.Condition()
+        # load shedding (tpuddp/serving/survive.py): requests whose deadline
+        # expired while still queued are dropped at assembly time — their
+        # futures get a typed AdmissionError(deadline_exceeded), and the
+        # engine's optional handler records the shed in its SLO stats. A
+        # request holding a failover journal (resume_tokens set — a live
+        # session mid-migration) is never shed: it is in-flight work.
+        self.shed_handler = None  # optional callable(request)
 
     # ---------------------------------------------------------- admission --
     def put(self, request: Request) -> None:
@@ -172,6 +197,20 @@ class RequestQueue:
             # cheap.
             self._cond.notify_all()
 
+    def requeue(self, request) -> None:
+        """Return an already-admitted request to the FRONT of its tenant
+        lane — the transient-retry / session-failover path. Bypasses
+        admission control entirely (depth bound, quota, and the closed
+        flag): the request was admitted once and is owed service, even by a
+        draining engine whose replica died mid-stream."""
+        with self._cond:
+            lane = self._lanes.get(request.tenant)
+            if lane is None:
+                lane = self._lanes[request.tenant] = deque()
+            lane.appendleft(request)
+            self._depth += 1
+            self._cond.notify_all()
+
     def close(self) -> None:
         """Stop admissions; queued work still drains. Wakes every waiter."""
         with self._cond:
@@ -198,17 +237,31 @@ class RequestQueue:
             return {t: len(lane) for t, lane in self._lanes.items() if lane}
 
     # ------------------------------------------------------------ draining --
+    @staticmethod
+    def _expired(request, now: float) -> bool:
+        """Queued-deadline check. A failover journal (``resume_tokens`` not
+        None — a live session awaiting migration) is in-flight work and is
+        exempt: deadlines shed queued work only, never kill a stream."""
+        return (
+            getattr(request, "deadline", None) is not None
+            and now > request.deadline
+            and getattr(request, "resume_tokens", None) is None
+        )
+
     def _assemble(
-        self, max_rows: int, key=None
+        self, max_rows: int, key=None, shed: Optional[List[Request]] = None
     ) -> Tuple[List[Request], Optional[tuple]]:
         """Pop up to ``max_rows`` rows of ``key``-matching requests,
         round-robin across tenant lanes (at most one request per tenant per
         pass). Caller holds the lock. The first pop fixes ``key`` when None.
         A lane whose head doesn't match (different sample shape, or too many
         rows to fit the remaining budget) is skipped, not popped — per-tenant
-        FIFO order is never reordered."""
+        FIFO order is never reordered. Expired heads are popped into
+        ``shed`` (never dispatched); the caller delivers their typed
+        rejections OUTSIDE the lock."""
         taken: List[Request] = []
         rows = 0
+        now = time.perf_counter()
         while True:
             lanes = list(self._lanes.keys())
             if not lanes:
@@ -221,6 +274,15 @@ class RequestQueue:
                 name = lanes[(start + i) % n]
                 lane = self._lanes.get(name)
                 if not lane:
+                    continue
+                # shed expired work before it can cost a dispatch — the
+                # deadline contract: queued-expired is rejected, in-flight
+                # is untouchable
+                while shed is not None and lane and self._expired(lane[0], now):
+                    shed.append(lane.popleft())
+                    self._depth -= 1
+                if not lane:
+                    del self._lanes[name]
                     continue
                 head = lane[0]
                 if key is not None and head.key != key:
@@ -253,38 +315,77 @@ class RequestQueue:
         ``None`` = closed and fully drained. ``wait=False`` never blocks:
         an open-but-empty queue returns ``[]`` — the decode loop's
         between-steps poll (it must keep stepping its active sequences, not
-        sleep on the condition variable, while the queue is empty)."""
-        with self._cond:
-            while self._depth == 0:
-                if self._closed:
-                    return None
-                if not wait:
-                    return []
-                self._cond.wait()
-            taken, key = self._assemble(max_rows)
-            if not taken:
-                # only possible when a queued request is bigger than
-                # max_rows — the engine's oversized admission check exists
-                # precisely so this cannot happen; fail loudly over spinning
-                raise RuntimeError(
-                    f"queued request(s) exceed the {max_rows}-row batch "
-                    "budget; admission should have rejected them as oversized"
-                )
-            # Linger for late arrivals ONLY while the queue is otherwise
-            # empty: under load there is more work right behind this batch,
-            # and a replica idling out the full linger on every dispatch
-            # would throttle saturation throughput for zero occupancy gain.
-            # At light load the linger is pure win — it coalesces a straggler
-            # into the in-hand batch instead of paying a whole extra
-            # dispatch for it.
-            if top_up_wait > 0 and self._depth == 0:
-                rows = sum(r.rows for r in taken)
-                deadline = time.monotonic() + top_up_wait
-                while rows < max_rows and not self._closed:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0 or not self._cond.wait(remaining):
-                        break
-                    more, _ = self._assemble(max_rows - rows, key)
-                    taken.extend(more)
-                    rows += sum(r.rows for r in more)
-            return taken
+        sleep on the condition variable, while the queue is empty).
+        Expired queued requests encountered during assembly are shed
+        (typed ``deadline_exceeded`` delivered to their futures after the
+        lock is released — never dispatched). The delivery happens BEFORE
+        the loop can re-block on the condition variable: a shed client's
+        verdict must not wait for the next arrival (or drain) to wake this
+        thread."""
+        while True:
+            shed: List[Request] = []
+            try:
+                with self._cond:
+                    while self._depth == 0:
+                        if self._closed:
+                            return None
+                        if not wait:
+                            return []
+                        self._cond.wait()
+                    taken, key = self._assemble(max_rows, shed=shed)
+                    if not taken:
+                        if not shed:
+                            # nothing shed AND nothing taken: a queued
+                            # request is bigger than max_rows — the engine's
+                            # oversized admission check exists precisely so
+                            # this cannot happen; fail loudly over spinning
+                            raise RuntimeError(
+                                f"queued request(s) exceed the {max_rows}-row "
+                                "batch budget; admission should have rejected "
+                                "them as oversized"
+                            )
+                        # everything assembled-over was expired — deliver the
+                        # shed verdicts (finally), then wait for live work
+                        continue
+                    # Linger for late arrivals ONLY while the queue is
+                    # otherwise empty: under load there is more work right
+                    # behind this batch, and a replica idling out the full
+                    # linger on every dispatch would throttle saturation
+                    # throughput for zero occupancy gain. At light load the
+                    # linger is pure win — it coalesces a straggler into the
+                    # in-hand batch instead of paying a whole extra dispatch
+                    # for it.
+                    if top_up_wait > 0 and self._depth == 0:
+                        rows = sum(r.rows for r in taken)
+                        deadline = time.monotonic() + top_up_wait
+                        while rows < max_rows and not self._closed:
+                            remaining = deadline - time.monotonic()
+                            if remaining <= 0 or not self._cond.wait(remaining):
+                                break
+                            more, _ = self._assemble(
+                                max_rows - rows, key, shed=shed
+                            )
+                            taken.extend(more)
+                            rows += sum(r.rows for r in more)
+                    return taken
+            finally:
+                for request in shed:
+                    self._deliver_shed(request)
+
+    def _deliver_shed(self, request) -> None:
+        """Fail one expired request's future with the typed rejection and
+        notify the engine's shed handler (stats). Called OUTSIDE the queue
+        lock — the handler may take the stats lock, which the exporter
+        holds while reading queue depth (lock-order safety)."""
+        waited = time.perf_counter() - request.t_enqueue
+        err = AdmissionError(
+            REJECT_DEADLINE,
+            f"request {request.id} (tenant {request.tenant!r}) expired after "
+            f"{waited:.3f}s in queue; shed before dispatch",
+        )
+        request.result._deliver(None, error=err)
+        if self.shed_handler is not None:
+            try:
+                self.shed_handler(request)
+            except Exception:  # noqa: BLE001 — stats must not kill dispatch
+                pass

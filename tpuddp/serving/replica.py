@@ -84,7 +84,15 @@ def _restore_variables(
 
 class Replica:
     """One device's copy of the model: committed parameters + a private
-    jitted eval forward (one compiled program per batch bucket)."""
+    jitted eval forward (one compiled program per batch bucket).
+
+    Survivability state machine (tpuddp/serving/survive.py): ``state`` is
+    ``healthy`` (routed to), ``recovering`` (in probation — the engine is
+    rebuilding it off the serving path), or ``removed`` (probation
+    exhausted; permanently out of routing). ``recoveries`` counts lifetime
+    probation rejoins (bounded by the policy's ``max_recoveries``);
+    ``broken`` simulates device death for chaos injection
+    (``replica_kill`` — every dispatch raises until :meth:`rebuild`)."""
 
     def __init__(self, index: int, device, module: Module, params, model_state):
         self.index = index
@@ -92,6 +100,27 @@ class Replica:
         self.module = module
         self.params = jax.device_put(params, device)
         self.model_state = jax.device_put(model_state, device)
+        self._fwd = jax.jit(self._make_fwd())
+        self.dispatches = 0
+        # graceful degradation (ISSUE 7 satellite, survivability layer): the
+        # engine marks a replica unhealthy after K consecutive dispatch
+        # errors; it then enters probation instead of dying forever. A
+        # successful dispatch resets the streak.
+        self.state = "healthy"
+        self.consecutive_errors = 0
+        self.recoveries = 0
+        self.broken = False
+        # True while this replica's dispatch-loop THREAD is running — the
+        # survivor check must not hand retried/queued traffic to a peer
+        # whose loop already exited at drain (state alone cannot tell)
+        self.loop_alive = False
+
+    @property
+    def healthy(self) -> bool:
+        return self.state == "healthy"
+
+    def _make_fwd(self):
+        module = self.module
 
         def fwd(p, s, x):
             # eval-mode forward, the FusedEvaluator's exact context: no
@@ -102,18 +131,15 @@ class Replica:
             logits, _ = module.apply(p, s, x, ctx)
             return logits
 
-        self._fwd = jax.jit(fwd)
-        self.dispatches = 0
-        # graceful degradation (ISSUE 7 satellite): the engine marks a
-        # replica unhealthy after K consecutive dispatch errors and stops
-        # routing to it; healthy peers keep serving. A successful dispatch
-        # resets the streak.
-        self.healthy = True
-        self.consecutive_errors = 0
+        return fwd
 
     def infer(self, x) -> jax.Array:
         """Dispatch one padded batch; returns device logits (async — the
         caller fences when it fetches rows)."""
+        if self.broken:
+            raise RuntimeError(
+                f"replica {self.index} is down (injected replica_kill)"
+            )
         self.dispatches += 1
         return self._fwd(self.params, self.model_state, x)
 
@@ -124,6 +150,28 @@ class Replica:
             x = np.zeros((b,) + tuple(sample_shape), dtype)
             jax.block_until_ready(self.infer(x))
         self.dispatches = 0
+
+    # ---------------------------------------------------------- recovery --
+    def rebuild(self) -> None:
+        """Probation step 1: rebuild the replica's device state — recommit
+        the parameters and re-jit the forward (the moral equivalent of
+        restarting the device's program state). Clears an injected
+        ``replica_kill``: a restart is exactly what fixes a killed device."""
+        self.params = jax.device_put(self.params, self.device)
+        self.model_state = jax.device_put(self.model_state, self.device)
+        self._fwd = jax.jit(self._make_fwd())
+        self.broken = False
+
+    def canary(self, sample_shape, dtype=np.float32) -> None:
+        """Probation step 2: probe with one real (smallest-bucket) dispatch
+        and require finite logits — a replica that cannot serve the canary
+        does not rejoin routing."""
+        x = np.zeros((1,) + tuple(sample_shape), dtype)
+        out = np.asarray(self.infer(x))
+        if not np.all(np.isfinite(out)):
+            raise RuntimeError(
+                f"replica {self.index} canary produced non-finite logits"
+            )
 
 
 class ReplicaPool:

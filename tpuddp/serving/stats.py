@@ -62,6 +62,12 @@ class ServingStats:
         self.per_tenant_completed = Counter()
         self.batches = 0
         self.bucket_rows = 0
+        # survivability accounting (tpuddp/serving/survive.py): queued
+        # requests shed past their deadline (also counted in
+        # rejects["deadline_exceeded"] — a shed IS a rejection) and
+        # transient dispatch failures re-queued within the retry budget
+        self.shed = 0
+        self.retries = 0
         self._queue_ms: list = []
         self._device_ms: list = []
         self._e2e_ms: list = []
@@ -75,7 +81,7 @@ class ServingStats:
         self._win_t0 = self._t0
         self._win_start = dict(
             completed=0, submitted=0, rejected=0, batches=0, rows=0,
-            bucket_rows=0,
+            bucket_rows=0, shed=0, retries=0,
         )
         # live-plane state: the last emitted serving_stats record — what a
         # /metrics scrape serves, so live values can never disagree with the
@@ -99,6 +105,20 @@ class ServingStats:
     def record_reject(self, tenant: str, reason: str) -> None:
         with self._lock:
             self.rejects[reason] += 1
+
+    def record_shed(self, tenant: str) -> None:
+        """One queued request dropped past its deadline (load shedding) —
+        a rejection with reason ``deadline_exceeded`` plus the dedicated
+        shed counter the autoscaler's shed-rate rule scrapes."""
+        with self._lock:
+            self.rejects["deadline_exceeded"] += 1
+            self.shed += 1
+
+    def record_retry(self, tenant: str) -> None:
+        """One transient dispatch failure re-queued within the per-tenant
+        retry budget (the request did NOT fail through to its client)."""
+        with self._lock:
+            self.retries += 1
 
     def record_batch(self, batch, t_dispatch: float, t_done: float) -> None:
         """One dispatched batch delivered: fan its timing out to every
@@ -159,6 +179,9 @@ class ServingStats:
             "batch_occupancy": (
                 round(rows / bucket_rows, 4) if bucket_rows else None
             ),
+            # survivability accounting (required at schema v7)
+            "shed": self.shed - self._win_start["shed"],
+            "retries": self.retries - self._win_start["retries"],
         }
         if self.writer is not None:
             self.writer.write(schema.stamp("serving_stats", record))
@@ -175,6 +198,8 @@ class ServingStats:
             batches=self.batches,
             rows=self.completed_rows,
             bucket_rows=self.bucket_rows,
+            shed=self.shed,
+            retries=self.retries,
         )
         return record
 
@@ -185,7 +210,8 @@ class ServingStats:
             done = self.completed - self._win_start["completed"]
             rejected = sum(self.rejects.values()) - self._win_start["rejected"]
             requests = self.submitted - self._win_start["submitted"]
-            if done == 0 and rejected == 0 and requests == 0:
+            retries = self.retries - self._win_start["retries"]
+            if done == 0 and rejected == 0 and requests == 0 and retries == 0:
                 return None
             return self._emit_window(final=True)
 
@@ -256,6 +282,8 @@ class ServingStats:
                 rejected = sum(self.rejects.values())
                 rows = self.completed_rows
                 batches = self.batches
+                shed = self.shed
+                retries = self.retries
                 per_tenant = dict(self.per_tenant_completed)
                 win = dict(self.last_window) if self.last_window else None
             series = {
@@ -271,6 +299,14 @@ class ServingStats:
                 "serving_rows_total": exp.counter(rows, "sample rows served"),
                 "serving_batches_total": exp.counter(
                     batches, "device batches dispatched"
+                ),
+                # survivability counters (tpuddp/serving/survive.py) — the
+                # autoscaler's shed-rate rule scrapes serving_shed_total
+                "serving_shed_total": exp.counter(
+                    shed, "queued requests shed past their deadline"
+                ),
+                "serving_retries_total": exp.counter(
+                    retries, "transient dispatch failures retried in-budget"
                 ),
             }
             if per_tenant:
@@ -326,6 +362,10 @@ class ServingStats:
                     sum(1 for r in engine.pool.replicas if r.healthy),
                     "replicas still routed to",
                 )
+                series["serving_replica_recoveries_total"] = exp.counter(
+                    sum(r.recoveries for r in engine.pool.replicas),
+                    "probation episodes passed (replicas rejoined routing)",
+                )
             return series
 
         return source
@@ -341,6 +381,8 @@ class ServingStats:
                 "completed": self.completed,
                 "completed_rows": self.completed_rows,
                 "rejected": dict(self.rejects),
+                "shed": self.shed,
+                "retries": self.retries,
                 "per_tenant_completed": dict(self.per_tenant_completed),
                 "batches": self.batches,
                 "batch_occupancy": (
