@@ -337,7 +337,8 @@ def test_decode_stats_rows_and_run_meta_validate(tmp_path, cpu_devices):
     assert errors == [] and n >= 3
     records = [json.loads(l) for l in open(history) if l.strip()]
     meta = records[0]
-    assert meta["type"] == "run_meta" and meta["schema_version"] == 7
+    assert meta["type"] == "run_meta"
+    assert meta["schema_version"] == schema.SCHEMA_VERSION
     # v7: the survivability provenance is non-null on decode headers
     assert meta["survivability"]["max_recoveries"] == 2
     dec = meta["decode"]

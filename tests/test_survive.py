@@ -920,7 +920,7 @@ def test_serving_history_carries_shed_window_and_survivability_header(
     assert errors == []
     records = [json.loads(l) for l in open(history) if l.strip()]
     meta = records[0]
-    assert meta["schema_version"] == 7
+    assert meta["schema_version"] == schema.SCHEMA_VERSION
     assert meta["survivability"]["request_ttl_s"] == 30.0
     assert meta["survivability"]["retry_budget"] == 1
     windows = [r for r in records if r["type"] == "serving_stats"]

@@ -94,7 +94,11 @@ def normalize(path):
     return tag, payload.get("device") or "unknown", configs
 
 
-_FAMILIES = ("BENCH_r*.json", "SERVING_r*.json")
+_FAMILIES = ("BENCH_r*.json", "SERVING_r*.json", "MULTICHIP_r*.json")
+# MULTICHIP rows: r01-r05 are raw driver captures with no per-config rows
+# (normalize() reports + skips them); r06+ carry the 2-D-mesh proving rows
+# (tools/bench_mesh.py — tokens/sec + per-chip param-byte cut) and are
+# judged like every other family.
 
 
 def load_artifacts(fresh=None, repo=_REPO):

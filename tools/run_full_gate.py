@@ -39,7 +39,16 @@ baseline, final-epoch losses must sit within the documented per-hook parity
 bound of the uncompressed run, and hierarchical rows must report inter-host
 bytes below the flat total.
 
-Serving gate (after the comm-matrix gate): ``tools/loadgen.py --quick`` stands the continuous-
+Mesh gate (after the comm-matrix gate): ``tools/bench_mesh.py --quick``
+trains transformer_small on the 2-D ``("data", "model")`` mesh (TP=2xDP=2)
+AND as pure DP=4 at matched global batch through the real epoch driver,
+asserting loss-trajectory parity and the per-chip parameter-byte cut; the
+gate independently re-validates the TP history (schema v8, the run_meta
+``mesh`` block with a real tp_rules_hash), runs the ``model=1`` HLO
+byte-identity test against the flat DDP path, and feeds the fresh
+MULTICHIP-format payload through ``tools/bench_trend.py --fresh``.
+
+Serving gate (after the mesh gate): ``tools/loadgen.py --quick`` stands the continuous-
 batching engine up on the CPU mesh (2 replicas, 2 tenants, ~170 requests
 across a closed-loop calibration + 3 offered-load points) and both emitted
 artifacts — the engine's ``history.jsonl`` (run_meta + serving_stats +
@@ -711,6 +720,105 @@ def _pipeline_gate(env) -> int:
     return 0
 
 
+def _mesh_gate(env) -> int:
+    """2-D mesh leg (ISSUE 14): ``tools/bench_mesh.py --quick`` trains
+    transformer_small TP=2xDP=2 AND pure DP=4 at matched global batch
+    through the real epoch driver on the 4-device CPU mesh, asserting
+    loss-trajectory parity and the per-chip parameter-byte cut in-process.
+    This leg re-checks the observable evidence independently: the TP
+    history validates under schema v8 and its run_meta carries the mesh
+    block ({data: 2, model: 2} + a real tp_rules_hash); the ``model=1``
+    configuration lowers to HLO byte-identical with the flat DDP path (the
+    dedicated test lowers both programs and compares text); and
+    ``tools/bench_trend.py --fresh`` ingests the fresh MULTICHIP-format
+    payload without a regression verdict."""
+    import json
+
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    with tempfile.TemporaryDirectory(prefix="tpuddp_mesh_gate_") as tmp:
+        worker_env = dict(env)
+        worker_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        bench_json = os.path.join(tmp, "mesh_bench.json")
+        out = subprocess.run(
+            [
+                sys.executable, "-u",
+                os.path.join(REPO, "tools", "bench_mesh.py"),
+                "--quick", "--history-dir", tmp, "--out", bench_json,
+            ],
+            cwd=REPO, env=worker_env, stdout=subprocess.PIPE, text=True,
+        )
+        sys.stdout.write(out.stdout)
+        if out.returncode != 0:
+            print(f"mesh gate: bench_mesh exited {out.returncode}",
+                  file=sys.stderr)
+            return out.returncode or 1
+        summary = json.loads(
+            [l for l in out.stdout.splitlines() if l.strip()][-1]
+        )
+        history = summary["tp_history"]
+        rc = subprocess.call(
+            [sys.executable, inspect, "--validate", history],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("mesh gate: TP=2xDP=2 history failed validation",
+                  file=sys.stderr)
+            return rc
+        with open(history) as f:
+            meta = next(
+                json.loads(l) for l in f
+                if l.strip() and json.loads(l).get("type") == "run_meta"
+            )
+        mesh_block = meta.get("mesh")
+        if (
+            not isinstance(mesh_block, dict)
+            or mesh_block.get("data") != 2
+            or mesh_block.get("model") != 2
+            or not mesh_block.get("tp_rules_hash")
+        ):
+            print(f"mesh gate: run_meta mesh block wrong: {mesh_block!r}",
+                  file=sys.stderr)
+            return 1
+        # model=1 HLO byte-identity with the flat DDP path: the dedicated
+        # test lowers both programs and compares text. Plain env —
+        # tests/conftest.py owns its own 8-device XLA_FLAGS.
+        rc = subprocess.call(
+            [
+                sys.executable, "-m", "pytest", "-q",
+                "tests/test_mesh2d.py", "-k", "hlo_identity",
+                "-p", "no:cacheprovider",
+            ],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("mesh gate: model=1 HLO identity test failed",
+                  file=sys.stderr)
+            return rc
+        rc = subprocess.call(
+            [
+                sys.executable,
+                os.path.join(REPO, "tools", "bench_trend.py"),
+                "--fresh", bench_json,
+            ],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("mesh gate: bench_trend rejected the fresh mesh payload",
+                  file=sys.stderr)
+            return rc
+        print(
+            "mesh gate: TP=2xDP=2 parity "
+            f"(worst |dloss| {summary['parity_worst_abs']:.2e}), per-chip "
+            f"param cut {summary['param_bytes_cut'] * 100:.1f}%, schema-v8 "
+            "mesh block + model=1 HLO identity + trend ingest verified"
+        )
+    return 0
+
+
 def _fleet_gate(env) -> int:
     """Fleet-control-plane leg (ISSUE 11): the scripted multi-job chaos
     demo (2 training + 1 serving + 1 late high-priority arrival on one
@@ -952,6 +1060,9 @@ def main(argv=None):
     if rc != 0:
         return rc
     rc = _comm_matrix_gate(env)
+    if rc != 0:
+        return rc
+    rc = _mesh_gate(env)
     if rc != 0:
         return rc
     rc = _serving_gate(env)

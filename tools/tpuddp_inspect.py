@@ -140,6 +140,9 @@ def summarize_history(path: str) -> None:
         print(f"run_meta ({len(metas)} header(s); newest):")
         for k in (
             "api", "model", "dataset", "config_hash", "mesh_shape",
+            # the v8 2-D mesh block: data/model axis widths + the TP
+            # rule-table hash when the model axis is real
+            "mesh",
             "world_size", "process_count", "device_kind", "jax_version",
             "tpuddp_version", "comm_hook", "comm_topology", "comm_density",
             "scan_steps", "grad_accumulation", "step_stats_every",

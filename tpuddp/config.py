@@ -265,6 +265,102 @@ OBSERVABILITY_DEFAULTS = {
 }
 
 
+# 2-D mesh knobs (tpuddp/parallel/mesh2d.py) — the top-level ``parallel``
+# block of a settings file: how the device world factors into the
+# ("data", "model") grid. Same unknown-key-refusal contract as every block.
+PARALLEL_DEFAULTS = {
+    "data": "auto",  # data-parallel width; "auto" -> world_size / model
+    "model": 1,  # tensor-parallel width (1 = plain DDP, today's behavior —
+    # the 2-D mesh with model=1 collapses to the flat data mesh and lowers
+    # to byte-identical HLO). > 1 shards the transformer family's
+    # attention/MLP/vocab weights 1/M per chip (parallel/tensor.py).
+}
+
+
+def parallel_config(settings: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge the settings file's ``parallel`` block over
+    :data:`PARALLEL_DEFAULTS`, refusing unknown keys."""
+    return resolve_parallel(settings.get("parallel"))
+
+
+def resolve_parallel(block) -> Dict[str, Any]:
+    """Resolve a ``parallel`` block (None/dict) to the full knob dict."""
+    if block is None:
+        return dict(PARALLEL_DEFAULTS)
+    if not isinstance(block, dict):
+        raise ValueError(f"parallel block must be a mapping, got {block!r}")
+    cfg = _merge_refusing_unknown(PARALLEL_DEFAULTS, block, "parallel")
+    model = int(cfg["model"])
+    if model < 1:
+        raise ValueError(f"parallel.model must be >= 1, got {cfg['model']!r}")
+    cfg["model"] = model
+    if cfg["data"] != "auto":
+        data = int(cfg["data"])
+        if data < 1:
+            raise ValueError(f"parallel.data must be >= 1 or 'auto', got {cfg['data']!r}")
+        cfg["data"] = data
+    return cfg
+
+
+def mesh_from(
+    parallel,
+    world_size: Optional[int] = None,
+    comm_topology: str = "flat",
+    devices=None,
+    backend: Optional[str] = None,
+):
+    """Build the run's device mesh from the ``parallel`` block.
+
+    ``model=1`` keeps today's meshes exactly: the flat data mesh, or the
+    factored ``("host", "local")`` mesh under ``comm_topology:
+    hierarchical``. ``model > 1`` builds the 2-D ``("data", "model")`` grid
+    (tpuddp/parallel/mesh2d.py). Refused loudly, never guessed:
+
+    - ``data * model != device_count`` (an explicit ``data`` that does not
+      tile the world would silently train a different replica count);
+    - ``hierarchical`` + ``model > 1`` (the factored data axis and the model
+      axis would need a 3-D mesh the comm hooks do not express).
+    """
+    from tpuddp.parallel.mesh import data_mesh, hierarchical_mesh, local_mesh_devices
+    from tpuddp.parallel.mesh2d import mesh2d
+
+    cfg = resolve_parallel(parallel)
+    model = cfg["model"]
+    if comm_topology == "hierarchical" and model > 1:
+        raise ValueError(
+            "parallel.model > 1 with comm_topology='hierarchical' is "
+            "refused: pick the 2-D ('data', 'model') mesh OR the factored "
+            "('host', 'local') data axis, not both"
+        )
+    if model == 1 and cfg["data"] == "auto":
+        if comm_topology == "hierarchical":
+            return hierarchical_mesh(world_size, devices=devices, backend=backend)
+        if devices is not None:
+            from tpuddp.parallel.mesh import make_mesh
+
+            return make_mesh(devices)
+        return data_mesh(world_size, backend)
+    if devices is None:
+        devices = local_mesh_devices(world_size, backend)
+    world = len(devices)
+    data = cfg["data"]
+    if data == "auto":
+        if world % model:
+            raise ValueError(
+                f"parallel.model={model} does not tile the {world}-device "
+                "world; data * model must equal the device count"
+            )
+        data = world // model
+    if data * model != world:
+        raise ValueError(
+            f"parallel: data={data} x model={model} != device count {world}; "
+            "the mesh must tile the world exactly (set data: auto to derive it)"
+        )
+    if model == 1 and comm_topology == "hierarchical":
+        return hierarchical_mesh(world_size, devices=devices, backend=backend)
+    return mesh2d(data, model, devices=devices)
+
+
 def observability_config(settings: Dict[str, Any]) -> Dict[str, Any]:
     """Merge the settings file's ``observability`` block over
     :data:`OBSERVABILITY_DEFAULTS`, refusing unknown keys."""

@@ -234,13 +234,31 @@ class ShardedDataLoader:
         self.drop_last = drop_last
 
         flat_devices = list(mesh.devices.flat)
-        self.world_size = len(flat_devices)
-        proc = jax.process_index()
-        # global ranks of this process's replicas, in mesh traversal order —
-        # must match how NamedSharding lays the global batch across devices.
-        self.local_ranks = [
-            rank for rank, d in enumerate(flat_devices) if d.process_index == proc
-        ]
+        axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        model = int(axis_sizes.get("model", 1))
+        if model > 1:
+            # 2-D ("data", "model") mesh: the DATA-parallel replica set is
+            # the data axis only — every device of one model group consumes
+            # the SAME rows (the batch lays out P("data"), replicated over
+            # "model"), so one sampler per data index, never per device.
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "ShardedDataLoader on a model-parallel mesh is "
+                    "single-controller only (parallel.model > 1 is refused "
+                    "multi-process at the DDP wrap too)"
+                )
+            self.world_size = len(flat_devices) // model
+            self.local_ranks = list(range(self.world_size))
+        else:
+            self.world_size = len(flat_devices)
+            proc = jax.process_index()
+            # global ranks of this process's replicas, in mesh traversal
+            # order — must match how NamedSharding lays the global batch
+            # across devices.
+            self.local_ranks = [
+                rank for rank, d in enumerate(flat_devices)
+                if d.process_index == proc
+            ]
         # base_sampler: a user-supplied full-dataset order source (iter + len
         # + optional set_epoch). Its order is PRESERVED and sharded around:
         # it feeds the per-replica DistributedSamplers as their order_source,

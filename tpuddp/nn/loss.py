@@ -23,8 +23,18 @@ def cross_entropy(
 
     reduction: 'mean' (weighted mean), 'sum', or 'none'.
     weights: optional per-sample weights/mask (N,).
+
+    Higher-rank logits (e.g. a language model's ``(B, T, V)`` with ``(B, T)``
+    labels/weights) flatten to per-token rows first — the token IS the sample
+    in that regime, so the weighted metric math applies unchanged
+    (``reduction='none'`` then returns the flattened per-token losses).
     """
     logits = logits.astype(jnp.float32)  # stable softmax even for bf16 nets
+    if logits.ndim > 2:
+        logits = logits.reshape(-1, logits.shape[-1])
+        labels = labels.reshape(-1)
+        if weights is not None:
+            weights = weights.reshape(-1)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     true_logit = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
     losses = logz - true_logit

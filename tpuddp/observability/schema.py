@@ -49,11 +49,18 @@ required ``shed`` field on ``serving_stats`` and ``decode_stats`` windows
 (deadline-expired requests dropped before dispatch) and the required
 ``failovers`` field on ``decode_stats`` (sessions migrated off a dead
 replica), plus the typed ``session_failover`` / ``replica_recovered`` /
-``replica_removed`` / ``no_healthy_replica`` event rows. Readers accept
-every version up to their own ``SCHEMA_VERSION`` and reject newer files;
-the per-version required-field sets apply at the version each record
-CARRIES, so a v2 history (no occupancy fields) stays valid under a v5
-reader.
+``replica_removed`` / ``no_healthy_replica`` event rows; v8 added the
+required run_meta ``mesh`` block (the 2-D device-mesh provenance,
+tpuddp/parallel/mesh2d.py): ``data``/``model`` axis widths plus the
+``tp_rules_hash`` of the tensor-parallel rule table when ``model > 1`` —
+a reader of a v8 header can tell a 4-chip pure-DP run from a TP=2xDP=2
+run without parsing mesh_shape, and two TP runs sharded under different
+rule tables never read as the same configuration. Null for writers with
+no mesh (serving headers), but the KEY must exist — absence is drift.
+Readers accept every version up to their own ``SCHEMA_VERSION`` and
+reject newer files; the per-version required-field sets apply at the
+version each record CARRIES, so a v2 history (no occupancy fields) stays
+valid under a v5 reader.
 """
 
 from __future__ import annotations
@@ -63,7 +70,7 @@ import hashlib
 import json
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 RECORD_TYPES = (
     "run_meta", "epoch", "step_stats", "event", "serving_stats",
@@ -186,6 +193,14 @@ _REQUIRED_SINCE = {
         "serving_stats": ("shed",),
         "decode_stats": ("shed", "failovers"),
     },
+    # v8: the 2-D device-mesh provenance (tpuddp/parallel/mesh2d.py). The
+    # value may be null (a writer with no mesh — serving headers) but the
+    # KEY must exist: a reader needs to distinguish "pure DP" (model=1)
+    # from "predates the 2-D mesh", and a model>1 block carries the
+    # tp_rules_hash naming the rule table that sharded the run.
+    8: {
+        "run_meta": ("mesh",),
+    },
 }
 
 def stamp(record_type: str, record: dict) -> dict:
@@ -218,13 +233,16 @@ def make_run_meta(
     observability: Optional[dict] = None,
     decode: Optional[dict] = None,
     survivability: Optional[dict] = None,
+    tp_rules_hash: Optional[str] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Build the run_meta header row from live run objects.
 
     ``mesh`` is a ``jax.sharding.Mesh`` (or None); ``guard`` is a
-    ``GuardConfig``/dict/None; ``extra`` carries entrypoint-level fields
-    (config_hash, model, dataset, scan_steps, ...)."""
+    ``GuardConfig``/dict/None; ``tp_rules_hash`` names the tensor-parallel
+    rule table when the mesh carries a model axis (the v8 ``mesh`` block);
+    ``extra`` carries entrypoint-level fields (config_hash, model, dataset,
+    scan_steps, ...)."""
     import jax
 
     import tpuddp
@@ -246,6 +264,21 @@ def make_run_meta(
         device_kind = jax.devices()[0].device_kind
     if dataclasses.is_dataclass(guard):
         guard = dataclasses.asdict(guard)
+    # required since schema v8: the 2-D mesh block — data/model axis widths
+    # (the hierarchical factoring folds into data) plus the TP rule-table
+    # hash when the model axis is real. Null when the writer has no mesh.
+    mesh_block = None
+    if mesh_shape is not None:
+        model_width = int(mesh_shape.get("model", 1))
+        data_width = 1
+        for name, size in mesh_shape.items():
+            if name != "model":
+                data_width *= int(size)
+        mesh_block = {
+            "data": data_width,
+            "model": model_width,
+            "tp_rules_hash": tp_rules_hash if model_width > 1 else None,
+        }
     record = {
         "jax_version": jax.__version__,
         "tpuddp_version": tpuddp.__version__,
@@ -253,6 +286,8 @@ def make_run_meta(
         "process_count": jax.process_count(),
         "process_index": jax.process_index(),
         "mesh_shape": mesh_shape,
+        # required since schema v8: the 2-D mesh provenance (null = no mesh)
+        "mesh": mesh_block,
         "device_kind": device_kind,
         "comm_hook": comm_hook,
         # required since schema v4: which wire topology the comm bytes

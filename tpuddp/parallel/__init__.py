@@ -19,6 +19,14 @@ from tpuddp.parallel.mesh import (  # noqa: F401
     make_mesh,
     replicated,
 )
+from tpuddp.parallel.mesh2d import (  # noqa: F401
+    AXIS_ROLES,
+    MODEL_AXIS,
+    data_size,
+    mesh2d,
+    model_size,
+    squeeze_model,
+)
 from tpuddp.parallel import collectives  # noqa: F401
 from tpuddp.parallel.sampler import DistributedSampler  # noqa: F401
 
@@ -33,6 +41,12 @@ __all__ = [
     "is_initialized",
     "setup",
     "DATA_AXIS",
+    "MODEL_AXIS",
+    "AXIS_ROLES",
+    "mesh2d",
+    "model_size",
+    "data_size",
+    "squeeze_model",
     "data_mesh",
     "data_sharded",
     "local_mesh_devices",

@@ -30,6 +30,12 @@ from tpuddp.parallel.mesh import (
     replicate,
     shard_batch,
 )
+from tpuddp.parallel.mesh2d import (
+    MODEL_AXIS as _MODEL_AXIS,
+    data_size as _mesh_data_size,
+    model_size as _mesh_model_size,
+    squeeze_model as _squeeze_model,
+)
 from tpuddp.resilience import guard as guard_lib
 from tpuddp.training import step as step_lib
 from tpuddp.training.train_state import TrainState, create_train_state
@@ -138,6 +144,23 @@ class DistributedDataParallel:
         else:
             self.mesh = data_mesh()
         self.mode = mode
+        # 2-D ("data", "model") mesh (parallel/mesh2d.py): model=1 collapses
+        # to the EXACT flat data mesh, so the legacy DDP construction below
+        # runs unchanged and lowers to byte-identical HLO; model>1 arms the
+        # tensor-parallel path (parallel/tensor.py) with its own refusal
+        # surface — a combo the TP step has no semantics for must fail at
+        # wrap time, not mistrain.
+        self.model_size = _mesh_model_size(self.mesh)
+        if _MODEL_AXIS in self.mesh.axis_names and self.model_size == 1:
+            self.mesh = _squeeze_model(self.mesh)
+        self.data_size = _mesh_data_size(self.mesh)
+        self._tp_specs = None  # P-tree of the TP param shards (model>1 only)
+        self._tp_opt_specs = None
+        if self.model_size > 1:
+            self._validate_tp(
+                mode, weight_update_sharding, grad_accumulation,
+                clip_grad_norm, augment, eval_transform, remat, optimizer,
+            )
         if self.comm_topology == "hierarchical":
             if mode != "shard_map":
                 raise ValueError(
@@ -200,10 +223,184 @@ class DistributedDataParallel:
         self._scan_step = None
         self._eval_scan_step = None
 
+    def _validate_tp(
+        self, mode, weight_update_sharding, grad_accumulation,
+        clip_grad_norm, augment, eval_transform, remat, optimizer,
+    ):
+        """Wrap-time refusal surface for the tensor-parallel path: every
+        combination the TP step has no semantics for fails HERE, loudly —
+        the alternative is a silently different training run."""
+        from tpuddp.parallel import tensor as tp_lib
+
+        tp_lib.validate_tp_geometry(self.model, self.model_size)
+        if mode != "shard_map":
+            raise ValueError(
+                "parallel.model > 1 needs the explicit per-replica step "
+                "(mode='shard_map'): the model-axis exchanges are written "
+                "over named mesh axes"
+            )
+        if self.comm_topology != "flat":
+            raise ValueError(
+                "parallel.model > 1 with comm_topology='hierarchical' is "
+                "refused: the factored ('host','local') data axis and the "
+                "model axis would need a 3-D mesh the comm hooks do not "
+                "express yet — pick one"
+            )
+        if weight_update_sharding:
+            raise ValueError(
+                "parallel.model > 1 with weight_update_sharding is refused: "
+                "the WUS flat layout spans the whole replicated parameter "
+                "vector, which a model-sharded state no longer has (the "
+                "ZeRO composition is ROADMAP item 2)"
+            )
+        if int(grad_accumulation) != 1:
+            raise ValueError(
+                "parallel.model > 1 with grad_accumulation > 1 is deferred; "
+                "scale the per-replica batch instead"
+            )
+        if clip_grad_norm is not None:
+            raise ValueError(
+                "parallel.model > 1 with clip_grad_norm is deferred: the "
+                "global norm of a model-sharded gradient needs a model-axis "
+                "reduction the clip path does not express yet"
+            )
+        if augment is not None or eval_transform is not None:
+            raise ValueError(
+                "parallel.model > 1 is a token-model path; image "
+                "augment/eval_transform hooks do not apply"
+            )
+        if remat:
+            raise ValueError("parallel.model > 1 with remat is deferred")
+        if type(optimizer).__name__ in ("LARS", "LAMB"):
+            raise ValueError(
+                "parallel.model > 1 with LARS/LAMB is deferred: per-layer "
+                "trust ratios over model-sharded leaves need model-axis "
+                "norm reductions; use adam/sgd/sgdw"
+            )
+        if jax.process_count() > 1:
+            raise ValueError(
+                "parallel.model > 1 is single-controller only for now "
+                "(every shard must be addressable for placement and "
+                "checkpoint gather)"
+            )
+
     # -- world introspection (dist.get_world_size analog) -------------------
     @property
     def world_size(self) -> int:
         return self.mesh.devices.size
+
+    @property
+    def tp_rules_hash(self):
+        """Short hash of the tensor-parallel rule table this wrap applies
+        (run_meta ``mesh.tp_rules_hash``); None on pure-DP wraps."""
+        if self.model_size <= 1:
+            return None
+        from tpuddp.parallel import tensor as tp_lib
+
+        return tp_lib.tp_rules_hash()
+
+    @property
+    def tp_param_specs(self):
+        """The PartitionSpec tree of the TP parameter shards (None on pure
+        DP) — the desync auditor needs it to fingerprint each device's OWN
+        shard and compare across data replicas only."""
+        return self._tp_specs
+
+    def _init_state_tp(self, key, sample_input, params, model_state) -> TrainState:
+        """The tensor-parallel init: full host init + broadcast (the DDP
+        construction contract, unchanged), then the QKV layout reshape, the
+        rule-table placement of params/moments over the model axis, the
+        LOCAL-shard gradient comm plan (data-axis exchange only), and the
+        per-(data, model)-device error-feedback residual."""
+        import numpy as np
+        from jax.sharding import NamedSharding
+
+        from tpuddp.parallel import tensor as tp_lib
+        from tpuddp.parallel.mesh import DATA_AXIS
+        from tpuddp.parallel.mesh2d import MODEL_AXIS
+
+        if (params is None) != (model_state is None):
+            raise ValueError(
+                "init_state needs params and model_state together: pretrained "
+                "params with freshly-initialized buffers would silently "
+                "mis-normalize"
+            )
+        if params is not None:
+            _, run_key = jax.random.split(key)
+            state = TrainState(
+                params=params,
+                model_state=model_state,
+                opt_state=None,
+                step=jnp.zeros((), jnp.int32),
+                rng=run_key,
+            )
+        else:
+            state = create_train_state(self.model, self.optimizer, key, sample_input)
+        state = col.broadcast_one_to_all(state)
+        host_params = jax.tree_util.tree_map(np.asarray, state.params)
+        tp_params = tp_lib.to_tp_tree(host_params)
+        self._tp_specs = tp_lib.tp_param_specs(self.model, tp_params)
+        # optimizer state over the TP-layout tree: moments inherit each
+        # parameter's spec by tree path, so each chip materializes only its
+        # shard's moments — the per-chip HBM cut covers m/v too
+        opt_state = self.optimizer.init(tp_params)
+        self._tp_opt_specs = tp_lib.opt_state_specs(
+            opt_state, tp_params, self._tp_specs
+        )
+        # gradient comm plan over the LOCAL shard template: hooks bucket the
+        # shard's flat vector and exchange it across DATA replicas only —
+        # the model axis never sees a gradient collective
+        local_tpl = tp_lib.local_param_template(
+            tp_params, self._tp_specs, self.model_size
+        )
+        self._comm = comm_lib.make_grad_comm(
+            local_tpl, self.data_size, self.comm_hook, self.bucket_cap_mb,
+            density=self.topk_density,
+        )
+        self._grad_comm_bytes = comm_lib.comm_bytes_for_hook(
+            local_tpl, self.data_size, self.comm_hook, wire=True,
+            bucket_cap_mb=self.bucket_cap_mb, density=self.topk_density,
+        )
+        self._grad_comm_bytes_f32 = comm_lib.comm_bytes_for_hook(
+            local_tpl, self.data_size, "none", wire=True,
+        )
+        self._grad_comm_breakdown = {
+            "total": self._grad_comm_bytes,
+            "inter_host": self._grad_comm_bytes,
+            "intra_host": 0,
+        }
+        self._state_spec = tp_lib.tp_state_spec(
+            self._tp_specs, self._tp_opt_specs, comm=self._comm
+        )
+        placed_params = tp_lib.place_tree(self.mesh, tp_params, self._tp_specs)
+        placed_opt = tp_lib.place_tree(self.mesh, opt_state, self._tp_opt_specs)
+        comm_state = None
+        if self._comm is not None and self._comm.needs_residual:
+            # one residual slice per (data_index, model_index) device,
+            # created device-side already sharded — P(("data", "model"))
+            # splits the flat vector data-major, model-minor, exactly the
+            # mesh's device order
+            n = self._comm.spec.total * self.world_size
+            comm_state = jax.jit(
+                lambda: jnp.zeros((n,), jnp.float32),
+                out_shardings=NamedSharding(
+                    self.mesh, step_lib.P((DATA_AXIS, MODEL_AXIS))
+                ),
+            )()
+        skipped = (
+            replicate(self.mesh, guard_lib.init_skip_counters())
+            if self.guard.enabled
+            else None
+        )
+        return self._audit_at_wrap(TrainState(
+            params=placed_params,
+            model_state=replicate(self.mesh, state.model_state),
+            opt_state=placed_opt,
+            step=replicate(self.mesh, state.step),
+            rng=replicate(self.mesh, state.rng),
+            comm_state=comm_state,
+            skipped_steps=skipped,
+        ))
 
     def init_state(self, key, sample_input, params=None, model_state=None) -> TrainState:
         """Create replicated train state. Parameters are broadcast from
@@ -214,6 +411,8 @@ class DistributedDataParallel:
         caller-supplied values (the pretrained fine-tune path,
         data_and_toy_model.py:41-45); optimizer state is re-derived from the
         supplied params."""
+        if self.model_size > 1:
+            return self._init_state_tp(key, sample_input, params, model_state)
         if (params is None) != (model_state is None):
             raise ValueError(
                 "init_state needs params and model_state together: pretrained "
@@ -387,9 +586,14 @@ class DistributedDataParallel:
         ``guard``, fingerprint every replica's parameter copy before the
         first step — a construction-time divergence (bad broadcast, corrupt
         host) surfaces as :class:`~tpuddp.resilience.guard.ReplicaDesync`
-        (exit 77) instead of a silently forked trajectory."""
+        (exit 77) instead of a silently forked trajectory. On a 2-D mesh the
+        fingerprints cover each device's OWN model shard and compare across
+        DATA replicas only — a tensor-parallel shard is *supposed* to differ
+        from its model-axis neighbor and must never be convicted for it."""
         if self.guard.enabled:
-            guard_lib.audit_or_raise(self.mesh, state.params, where="ddp-wrap")
+            guard_lib.audit_or_raise(
+                self.mesh, state.params, where="ddp-wrap", specs=self._tp_specs
+            )
         return state
 
     def shard(self, batch):
@@ -471,6 +675,15 @@ class DistributedDataParallel:
         training.step.build_train_scan_step)."""
         if self._scan_step is None:
             self._check_wus_ready()
+            if self.model_size > 1:
+                from tpuddp.parallel import tensor as tp_lib
+
+                self._scan_step = tp_lib.build_tp_train_scan_step(
+                    self.model, self.criterion, self.optimizer, self.mesh,
+                    self._state_spec, comm=self._comm,
+                    guard=self.guard.enabled,
+                )
+                return self._scan_step(state, stacked_batch)
             self._scan_step = step_lib.build_train_scan_step(
                 self.model,
                 self.criterion,
@@ -501,6 +714,15 @@ class DistributedDataParallel:
             )
         if self._train_step is None:
             self._check_wus_ready()
+            if self.model_size > 1:
+                from tpuddp.parallel import tensor as tp_lib
+
+                self._train_step = tp_lib.build_tp_train_step(
+                    self.model, self.criterion, self.optimizer, self.mesh,
+                    self._state_spec, comm=self._comm,
+                    guard=self.guard.enabled,
+                )
+                return self._train_step(state, batch)
             self._train_step = step_lib.build_train_step(
                 self.model,
                 self.criterion,
@@ -524,6 +746,13 @@ class DistributedDataParallel:
         training.step.build_eval_scan_step)."""
         if self._eval_scan_step is None:
             self._check_wus_ready()
+            if self.model_size > 1:
+                from tpuddp.parallel import tensor as tp_lib
+
+                self._eval_scan_step = tp_lib.build_tp_eval_scan_step(
+                    self.model, self.criterion, self.mesh, self._state_spec
+                )
+                return self._eval_scan_step(state, stacked_batch)
             self._eval_scan_step = step_lib.build_eval_scan_step(
                 self.model,
                 self.criterion,
@@ -537,6 +766,13 @@ class DistributedDataParallel:
     def eval_step(self, state: TrainState, batch):
         if self._eval_step is None:
             self._check_wus_ready()
+            if self.model_size > 1:
+                from tpuddp.parallel import tensor as tp_lib
+
+                self._eval_step = tp_lib.build_tp_eval_step(
+                    self.model, self.criterion, self.mesh, self._state_spec
+                )
+                return self._eval_step(state, batch)
             self._eval_step = step_lib.build_eval_step(
                 self.model,
                 self.criterion,
@@ -548,8 +784,15 @@ class DistributedDataParallel:
         return self._eval_step(state, batch)
 
     def forward(self, state: TrainState, x):
-        """Inference forward (replicated params, sharded batch)."""
+        """Inference forward (replicated params, sharded batch). On a
+        tensor-parallel wrap the shards are gathered to the canonical host
+        layout first — a debugging convenience, not a serving path."""
+        params, model_state = state.params, state.model_state
+        if self.model_size > 1:
+            from tpuddp.parallel import tensor as tp_lib
+
+            params = tp_lib.gather_params(params)
         logits, _ = self.model.apply(
-            state.params, state.model_state, x, Context(train=False)
+            params, model_state, x, Context(train=False)
         )
         return logits

@@ -1,0 +1,148 @@
+"""2-D ``("data", "model")`` device mesh — the axis layer tensor parallelism
+runs over (ROADMAP open item 1).
+
+The flat DDP mesh (:func:`tpuddp.parallel.mesh.data_mesh`) and the factored
+hierarchical mesh are both *1-D data-parallel*: every device holds a full
+parameter copy and the only cross-device exchange is the gradient reduction.
+:func:`mesh2d` generalizes that world into a ``data x model`` grid:
+
+- the **data** axis keeps DDP's contract — the batch splits over it, gradient
+  collectives reduce over it, replicas along it are supposed to agree bitwise;
+- the **model** axis is new — parameters *shard* over it following a model's
+  partition rules (tpuddp/parallel/tensor.py applies
+  ``tpuddp.models.transformer.partition_spec``'s table), activations exchange
+  over it inside the forward/backward, and shards along it are *supposed to
+  differ* (the desync auditor compares across ``data`` only).
+
+``mesh2d(data, 1)`` is definitionally today's DDP world:
+:func:`squeeze_model` collapses it back to the exact 1-D data mesh so the
+``model=1`` configuration lowers through the UNCHANGED existing code path
+(HLO byte-identity is asserted in tests/test_mesh2d.py).
+
+Axis registry (:data:`AXIS_ROLES`): the closed set of mesh axis names tpuddp
+builds, with the role each one plays. The config surface cannot express an
+unknown axis (the ``parallel`` block's key refusal covers it); programmatic
+callers minting axis names check them against the registry with
+:func:`validate_axis`.
+
+Device order: ``model`` is the MINOR axis, so the devices of one tensor-
+parallel group are adjacent in the flat device order — on a real slice that
+keeps the latency-critical per-block activation psums on the closest ICI
+hops, with the less frequent data-axis gradient reduction striding further.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from tpuddp.parallel.mesh import (
+    DATA_AXIS,
+    HOST_AXIS,
+    LOCAL_AXIS,
+    local_mesh_devices,
+    make_mesh,
+)
+
+MODEL_AXIS = "model"
+
+# The closed registry of mesh axis names and their roles. Everything tpuddp
+# builds is one of: the flat data axis, its ("host", "local") factoring, or
+# the 2-D (data, model) grid. An axis outside this set has no collectives,
+# no sharding rules, and no checkpoint story. The YAML surface cannot name
+# one (the parallel block refuses unknown keys, and mesh_from only ever
+# mints registered axes); code-level callers inventing an axis validate it
+# here via validate_axis instead of silently growing a fifth axis kind.
+AXIS_ROLES: Mapping[str, str] = {
+    DATA_AXIS: "batch sharding + gradient reduction (replicas agree bitwise)",
+    MODEL_AXIS: "tensor-parallel parameter sharding (shards legitimately differ)",
+    HOST_AXIS: "inter-host hop of the factored data axis (comm_topology=hierarchical)",
+    LOCAL_AXIS: "intra-host hop of the factored data axis (comm_topology=hierarchical)",
+}
+
+
+def validate_axis(name: str) -> str:
+    if name not in AXIS_ROLES:
+        raise ValueError(
+            f"unknown mesh axis {name!r}; the registry knows "
+            f"{sorted(AXIS_ROLES)} (tpuddp/parallel/mesh2d.AXIS_ROLES)"
+        )
+    return name
+
+
+def mesh2d(
+    data: int,
+    model: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+    backend: Optional[str] = None,
+) -> Mesh:
+    """The ``("data", "model")`` mesh: ``data * model`` devices reshaped into
+    a grid with ``model`` minor (tensor-parallel groups on adjacent devices).
+
+    ``model=1`` still builds the 2-D mesh (axes ``("data", "model")``,
+    trailing size 1); callers that want the byte-identical legacy DDP program
+    collapse it with :func:`squeeze_model` — DistributedDataParallel does
+    this automatically, so ``mesh2d(N, 1)`` IS the flat mesh end to end."""
+    data, model = int(data), int(model)
+    if data < 1 or model < 1:
+        raise ValueError(f"mesh2d axis sizes must be >= 1, got data={data}, model={model}")
+    if devices is None:
+        devices = local_mesh_devices(data * model, backend)
+    if len(devices) != data * model:
+        raise ValueError(
+            f"mesh2d(data={data}, model={model}) needs exactly "
+            f"{data * model} devices, got {len(devices)}"
+        )
+    return make_mesh(devices, axes={DATA_AXIS: data, MODEL_AXIS: model})
+
+
+def axis_sizes(mesh: Mesh) -> Mapping[str, int]:
+    """``{axis name: size}`` of a mesh."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def model_size(mesh: Optional[Mesh]) -> int:
+    """The tensor-parallel width of a mesh: the ``model`` axis size, or 1
+    for every 1-D data mesh (flat or hierarchical) — DDP is the ``model=1``
+    special case by definition."""
+    if mesh is None:
+        return 1
+    return int(axis_sizes(mesh).get(MODEL_AXIS, 1))
+
+
+def data_size(mesh: Mesh) -> int:
+    """The data-parallel width: every axis that is not ``model`` (the flat
+    ``data`` axis, or the ``host * local`` product on the factored mesh)."""
+    sizes = axis_sizes(mesh)
+    return int(np.prod([s for a, s in sizes.items() if a != MODEL_AXIS], dtype=int))
+
+
+def is_tensor_parallel(mesh: Optional[Mesh]) -> bool:
+    return model_size(mesh) > 1
+
+
+def squeeze_model(mesh: Mesh) -> Mesh:
+    """Collapse a ``model=1`` 2-D mesh to the exact flat data mesh over the
+    same devices (same order), so downstream step construction takes the
+    UNCHANGED 1-D code path and lowers to byte-identical HLO. A mesh whose
+    ``model`` axis is wider than 1 cannot be squeezed and raises."""
+    if MODEL_AXIS not in mesh.axis_names:
+        return mesh
+    if model_size(mesh) != 1:
+        raise ValueError(
+            f"cannot squeeze a model={model_size(mesh)} mesh to 1-D; only "
+            "the model=1 special case collapses to the flat DDP mesh"
+        )
+    return make_mesh(list(mesh.devices.flat))
+
+
+def describe(mesh: Optional[Mesh]) -> Optional[dict]:
+    """The run_meta ``mesh`` block's axis sizes: ``{"data": D, "model": M}``
+    (None for no mesh). The data width folds the hierarchical factoring, so
+    a reader never needs the axis registry to know the replica count."""
+    if mesh is None:
+        return None
+    return {"data": data_size(mesh), "model": model_size(mesh)}

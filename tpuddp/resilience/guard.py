@@ -204,15 +204,12 @@ def _leaf_fingerprint(leaf):
     return jnp.sum(flat.reshape(-1, _FP_CHUNK), axis=1)
 
 
-@functools.lru_cache(maxsize=8)
-def _audit_program(mesh):
-    """The compiled fingerprint-and-compare pass for ``mesh`` (cached per
-    mesh; jax.jit then caches per parameter tree structure, so repeated
-    audits on the same model never recompile)."""
+def _make_audit_check(mesh):
     from tpuddp.parallel.mesh import data_axes
 
-    axis = data_axes(mesh)  # the flat "data" axis, or the factored
-    # ("host", "local") tuple on a hierarchical comm-topology mesh
+    axis = data_axes(mesh)  # the flat "data" axis (also on a 2-D
+    # ("data", "model") mesh — TP shards are compared across data replicas
+    # ONLY), or the factored ("host", "local") tuple on a hierarchical mesh
 
     def check(tree):
         fp = jax.tree_util.tree_map(_leaf_fingerprint, tree)
@@ -223,12 +220,43 @@ def _audit_program(mesh):
             lambda v: lax.pmax(v, axis) - lax.pmin(v, axis), fp
         )
 
+    return check
+
+
+@functools.lru_cache(maxsize=8)
+def _audit_program(mesh):
+    """The compiled fingerprint-and-compare pass for ``mesh`` (cached per
+    mesh; jax.jit then caches per parameter tree structure, so repeated
+    audits on the same model never recompile)."""
     return jax.jit(
-        shard_map(check, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False)
+        shard_map(
+            _make_audit_check(mesh), mesh=mesh, in_specs=(P(),),
+            out_specs=P(), check_vma=False,
+        )
     )
 
 
-def audit_params(mesh, params) -> Optional[str]:
+def _tp_audit_program(mesh, specs):
+    """The tensor-parallel variant: ``specs`` is the parameter tree's
+    PartitionSpec pytree (model-axis shards), so every device fingerprints
+    its OWN shard and the pmax-pmin compare runs across DATA replicas only —
+    a TP shard legitimately differs from its model-axis neighbor and must
+    never be convicted for it. The per-shard diff vectors are exposed per
+    model index (out spec over the model axis), so a divergence on ANY
+    shard group is visible from the host. Built per call — audits run once
+    per wrap plus every guard.audit_every_n_epochs, never per step."""
+    from tpuddp.parallel.mesh2d import MODEL_AXIS
+
+    out_spec = jax.tree_util.tree_map(lambda _: P(MODEL_AXIS), specs)
+    return jax.jit(
+        shard_map(
+            _make_audit_check(mesh), mesh=mesh, in_specs=(specs,),
+            out_specs=out_spec, check_vma=False,
+        )
+    )
+
+
+def audit_params(mesh, params, specs=None) -> Optional[str]:
     """Compare every replica's copy of (nominally replicated) ``params``.
 
     Returns the keystr path of the FIRST divergent leaf, or None when all
@@ -236,8 +264,16 @@ def audit_params(mesh, params) -> Optional[str]:
     local copy of the buffer, so single-device corruption of a replicated
     array (bad host, bit flip, desynced update) is visible even though JAX
     treats the array as one logical value.
+
+    ``specs`` (a PartitionSpec pytree, the TP wrap's ``tp_param_specs``)
+    marks model-axis-sharded parameters on a 2-D mesh: fingerprints then
+    cover each device's own shard and the comparison runs across data
+    replicas only.
     """
-    diffs = _audit_program(mesh)(params)
+    program = (
+        _audit_program(mesh) if specs is None else _tp_audit_program(mesh, specs)
+    )
+    diffs = program(params)
     flat = jax.tree_util.tree_flatten_with_path(diffs)[0]
     # ONE host fetch for every (small) per-leaf diff vector
     host = jax.device_get([d for _, d in flat])
@@ -248,10 +284,10 @@ def audit_params(mesh, params) -> Optional[str]:
     return None
 
 
-def audit_or_raise(mesh, params, where: str) -> None:
+def audit_or_raise(mesh, params, where: str, specs=None) -> None:
     """Run :func:`audit_params`; raise :class:`ReplicaDesync` naming the
     first divergent leaf. The wrap-time entry point (DDP init_state /
-    Accelerator prepare)."""
-    leaf = audit_params(mesh, params)
+    Accelerator prepare). ``specs`` as in :func:`audit_params`."""
+    leaf = audit_params(mesh, params, specs=specs)
     if leaf is not None:
         raise ReplicaDesync(leaf, where=where)

@@ -47,6 +47,21 @@ match, no reshard). v1 checkpoints (no topology record) keep loading
 unchanged on their original topology; loaded onto a DIFFERENT world size
 their world-dependent leaves mismatch and raise :class:`TopologyMismatch`
 pointing at the v2 elastic path instead of reshaping or mis-slicing.
+
+2-D mesh (format v3, ISSUE 14): checkpoints written on a ``("data",
+"model")`` mesh additionally record the **model width** and a per-leaf
+``placement`` map (which mesh axes each sharded leaf's dimensions split
+over). Parameter/moment leaves are stored as their FULL logical arrays (the
+single-controller save gathers shards transparently), so they are
+model-width-independent on disk — what is NOT width-independent is the
+per-``(data, model)``-device error-feedback residual. ``load`` /
+``restore_latest`` take the current ``model_size`` and REFUSE a
+cross-model-width restore with a typed :class:`TopologyMismatch` instead of
+mis-slicing: there is no resharding story for the model axis (elastic
+``model``-width resharding is explicitly deferred — README "2-D mesh").
+A v2 file written on a 2-D mesh carries the mesh axes/shape, so the same
+refusal applies to it; a v1/DP file loaded onto a TP run (or vice versa)
+refuses identically.
 """
 
 from __future__ import annotations
@@ -66,7 +81,12 @@ from tpuddp.resilience import faults, integrity
 
 logger = logging.getLogger("tpuddp")
 
-FORMAT_VERSION = 2  # v2 = topology record present (elastic resume)
+FORMAT_VERSION = 3  # v2 = topology record present (elastic resume);
+# v3 = the record additionally carries model_size + per-leaf mesh-axis
+# placement tags (the 2-D ("data", "model") mesh — ISSUE 14). v2 files keep
+# loading: readers key on record CONTENTS, and a v2 record written on a 2-D
+# mesh already names its mesh axes/shape, so the cross-model-width refusal
+# covers it too.
 
 _KEY_MARK = "__prngkey__"
 _BF16_MARK = "__bf16__"  # npz can't serialize ml_dtypes natively (loads back
@@ -141,44 +161,120 @@ def derive_topology(tree: Any, world_size: Optional[int] = None) -> Optional[dic
             break
     if world is None:
         return None
+    model = 1
+    if mesh_axes and mesh_shape and "model" in mesh_axes:
+        model = int(mesh_shape[mesh_axes.index("model")])
+
+    def spec_axes(sh):
+        """JSON-able per-dimension mesh-axis placement of a NamedSharding's
+        spec (tuple entries become lists) — the v3 leaf placement tag."""
+        try:
+            out = []
+            for entry in tuple(sh.spec):
+                if entry is None:
+                    out.append(None)
+                elif isinstance(entry, (tuple, list)):
+                    out.append([str(a) for a in entry])
+                else:
+                    out.append(str(entry))
+            return out
+        except Exception:
+            return None
+
     leaves: Dict[str, dict] = {}
+    placement: Dict[str, list] = {}
     for p, leaf in flat:
         key = _path_str(p)
-        if np.ndim(leaf) != 1:
-            continue
         sh = sharding_of(leaf)
         sharded = sh is not None and not sh.is_fully_replicated
+        if sharded:
+            # v3: every sharded leaf names the mesh axes each dimension
+            # splits over — params/moments on the model axis included (they
+            # are SAVED as full gathered arrays, so the tag is provenance
+            # plus the refusal surface, not a reshape instruction)
+            axes = spec_axes(sh)
+            if axes is not None:
+                placement[key] = axes
+        if np.ndim(leaf) != 1:
+            continue
         n = int(np.shape(leaf)[0])
         if key in _COMM_FLAT_KEYS:
             if sharded and n % world == 0:
-                # shard_map bf16_ef: (world * per,) per-replica residual,
-                # P("data") — redistributed on a world change
+                # shard_map EF residual: (world * per,) per-replica slices.
+                # On a 2-D mesh the slices key by (data_index, model_index)
+                # — "model" > 1 marks them NON-redistributable across any
+                # width change (the typed-refusal path).
                 leaves[key] = {
                     "kind": "per_replica", "world": world, "per": n // world,
+                    "model": model,
                 }
             else:
                 # auto-mode bf16_ef: the replicated (total,) aggregate
                 # residual — world-dependent only through its padding
                 leaves[key] = {"kind": "data_flat"}
-        elif _is_opt_state_key(key) and sharded:
+        elif _is_opt_state_key(key) and sharded and model == 1:
             # weight-update-sharded flat moment vector: (total,) padded to a
             # world multiple, sharded over the data axis — re-padded on load
             leaves[key] = {"kind": "data_flat"}
     return {
         "format": FORMAT_VERSION,
         "world_size": world,
+        "model_size": model,
         "mesh_axes": mesh_axes,
         "mesh_shape": mesh_shape,
         "leaves": leaves,
+        "placement": placement,
     }
 
 
 def read_topology(path: str) -> Optional[dict]:
-    """The v2 topology record of a checkpoint (None for v1 files)."""
+    """The v2/v3 topology record of a checkpoint (None for v1 files)."""
     with np.load(path) as data:
         if _TOPO_MARK not in data.files:
             return None
         return json.loads(str(np.asarray(data[_TOPO_MARK]).item()))
+
+
+def topology_model_size(topo: Optional[dict]) -> int:
+    """The model-axis width a checkpoint was written under: the explicit v3
+    field, else derived from the v2 record's mesh axes (a v2 file written on
+    a 2-D mesh already named them), else 1 — every 1-D data mesh IS the
+    model=1 case."""
+    if not topo:
+        return 1
+    if topo.get("model_size") is not None:
+        return int(topo["model_size"])
+    axes, shape = topo.get("mesh_axes"), topo.get("mesh_shape")
+    if axes and shape and "model" in axes:
+        return int(shape[list(axes).index("model")])
+    return 1
+
+
+def _check_model_width(path: str, topo: Optional[dict], model_size) -> None:
+    """The cross-``model``-width refusal (ISSUE 14 satellite): a checkpoint
+    written under one tensor-parallel width restored under another would
+    mis-slice its per-device state (and a v1 file has no mesh record at
+    all) — raise the typed mismatch instead. Same width passes; the data
+    axis keeps its own elastic rules."""
+    cur = 1 if model_size is None else int(model_size)
+    if topo is None:
+        if cur > 1:
+            raise TopologyMismatch(
+                f"checkpoint {path} predates the topology record (format v1) "
+                f"and cannot be restored onto a model={cur} tensor-parallel "
+                "mesh; resume it on a pure-DP world (model=1) or re-save it "
+                "through save_on_main first"
+            )
+        return
+    saved = topology_model_size(topo)
+    if saved != cur:
+        raise TopologyMismatch(
+            f"checkpoint {path} was written on a model={saved} mesh but the "
+            f"current run is model={cur}: cross-model-width resharding is "
+            "not supported (elastic resharding covers the DATA axis only; "
+            "the model axis has no redistribution story — README '2-D "
+            "mesh'). Restore on a matching parallel.model width."
+        )
 
 
 def save(
@@ -307,6 +403,17 @@ def _fit_leaf(
             })
         return out
     if info["kind"] == "per_replica":
+        if int(info.get("model", 1) or 1) > 1:
+            # a 2-D-mesh residual keys by (data_index, model_index); the
+            # row-group redistribution below assumes pure data rows, so a
+            # DATA-width change under tensor parallelism refuses instead of
+            # sum-merging across unrelated model shards
+            raise TopologyMismatch(
+                f"checkpoint {path}: per-replica leaf {key!r} was written on "
+                f"a model={info['model']} mesh; elastic DATA-axis resharding "
+                "of a tensor-parallel error-feedback residual is deferred — "
+                "resume on the same (data, model) grid"
+            )
         if world_size is None:
             raise TopologyMismatch(
                 f"checkpoint {path}: per-replica leaf {key!r} (saved on a "
@@ -366,15 +473,19 @@ def load_with_topology(
     like: Any,
     world_size: Optional[int] = None,
     reshard_actions: Optional[List[dict]] = None,
+    model_size: Optional[int] = None,
 ) -> Tuple[Any, Optional[dict]]:
     """:func:`load` plus the file's parsed topology record (None for v1) —
     one file open for callers that need both (restore_latest, the managed
-    load_state)."""
+    load_state). ``model_size`` is the CURRENT tensor-parallel width (None =
+    1, every pre-2-D caller); a width mismatch against the file's record is
+    a typed :class:`TopologyMismatch` BEFORE any leaf is touched."""
     with np.load(path) as data:
         stored = dict(data.items())
     topo = None
     if _TOPO_MARK in stored:
         topo = json.loads(str(np.asarray(stored[_TOPO_MARK]).item()))
+    _check_model_width(path, topo, model_size)
     flat, treedef = jax.tree_util.tree_flatten_with_path(like)
     leaves = []
     for p, template in flat:
@@ -440,6 +551,7 @@ def load(
     like: Any,
     world_size: Optional[int] = None,
     reshard_actions: Optional[List[dict]] = None,
+    model_size: Optional[int] = None,
 ) -> Any:
     """Restore a pytree saved by :func:`save`, using ``like`` for structure.
     Leaf shapes and dtypes are validated against ``like``; mismatches raise
@@ -449,9 +561,12 @@ def load(
     world-size-dependent leaf's shape differs from the template's, the leaf
     is resharded onto the current topology (see the module doc) instead of
     failing. ``world_size`` is the CURRENT world (needed to redistribute
-    per-replica leaves); ``reshard_actions`` (a caller-supplied list) is
-    appended with one dict per resharded leaf."""
-    return load_with_topology(path, like, world_size, reshard_actions)[0]
+    per-replica leaves); ``model_size`` the current tensor-parallel width
+    (cross-width restores refuse typed); ``reshard_actions`` (a
+    caller-supplied list) is appended with one dict per resharded leaf."""
+    return load_with_topology(
+        path, like, world_size, reshard_actions, model_size=model_size
+    )[0]
 
 
 def build_reshard_events(
@@ -622,6 +737,7 @@ def restore_latest(
     prefix: str = "ckpt",
     world_size: Optional[int] = None,
     reshard_log: Optional[List[dict]] = None,
+    model_size: Optional[int] = None,
 ) -> Tuple[Any, int]:
     """Load the newest intact checkpoint into ``like``'s structure. Returns
     ``(tree, next_epoch)``; ``(like, 0)`` when none exists. An emergency save
@@ -631,18 +747,22 @@ def restore_latest(
 
     Elastic resume: ``world_size`` is the CURRENT world; a v2 checkpoint
     written on a different world is resharded onto it (see :func:`load`).
-    ``reshard_log`` (a caller-supplied list) then receives ready-to-write
-    typed event dicts — one ``topology_change`` summary naming the worlds
-    and the resharded leaves, plus one ``comm_state_reset`` per residual
-    that had to reset (M∤N) — so the epoch driver can land them as event
-    rows in history.jsonl."""
+    ``model_size`` is the current tensor-parallel width — a checkpoint
+    written under a DIFFERENT model width raises the typed
+    :class:`TopologyMismatch` instead of mis-slicing (no model-axis
+    resharding story exists). ``reshard_log`` (a caller-supplied list)
+    receives ready-to-write typed event dicts — one ``topology_change``
+    summary naming the worlds and the resharded leaves, plus one
+    ``comm_state_reset`` per residual that had to reset (M∤N) — so the
+    epoch driver can land them as event rows in history.jsonl."""
     found = latest(save_dir, prefix)
     if found is None:
         return like, 0
     path, epoch = found
     actions: List[dict] = []
     tree, topo = load_with_topology(
-        path, like, world_size=world_size, reshard_actions=actions
+        path, like, world_size=world_size, reshard_actions=actions,
+        model_size=model_size,
     )
     if reshard_log is not None:
         reshard_log.extend(

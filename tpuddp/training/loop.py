@@ -253,6 +253,7 @@ def run_training_loop(
             state, resumed = ckpt.restore_latest(
                 save_dir, state,
                 world_size=getattr(ddp, "world_size", None),
+                model_size=getattr(ddp, "model_size", None),
                 reshard_log=reshard_log,
             )
             if resumed > start_epoch:
@@ -343,6 +344,9 @@ def run_training_loop(
         comm_topology=getattr(ddp, "comm_topology", "flat"),
         guard=guard_cfg,
         observability=obs_meta,
+        # v8 mesh block: names the TP rule table when the mesh carries a
+        # real model axis (None on pure-DP wraps)
+        tp_rules_hash=getattr(ddp, "tp_rules_hash", None),
         extra=meta_extra,
     ))
     for ev in reshard_log:
@@ -422,6 +426,7 @@ def run_training_loop(
         restored, redo_epoch = ckpt.restore_latest(
             save_dir, cur_state,
             world_size=getattr(ddp, "world_size", None),
+            model_size=getattr(ddp, "model_size", None),
             reshard_log=rb_log,
         )
         metrics_writer.write(stamp("event", {
@@ -531,7 +536,10 @@ def run_training_loop(
                 # desync audit: ONE fingerprint reduction over the parameter
                 # tree per audited epoch (guard.audit_params cost model) —
                 # the periodic re-run of the wrap-time verify
-                bad_leaf = guard_lib.audit_params(ddp.mesh, state.params)
+                bad_leaf = guard_lib.audit_params(
+                    ddp.mesh, state.params,
+                    specs=getattr(ddp, "tp_param_specs", None),
+                )
                 if bad_leaf is not None:
                     metrics_writer.write(stamp(
                         "event",

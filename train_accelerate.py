@@ -805,6 +805,15 @@ if __name__ == "__main__":
     settings = cfg_lib.load_settings(args.settings_file)
     out_dir = cfg_lib.prepare_out_dir(settings, args.settings_file)
     training = cfg_lib.training_config(settings)
+    # 2-D mesh: the managed path has no tensor-parallel step (the TP
+    # exchanges are written over the explicit shard_map axes) — refuse a
+    # model-parallel parallel block here instead of training something else
+    if cfg_lib.parallel_config(settings)["model"] > 1:
+        raise ValueError(
+            "parallel.model > 1 needs the explicit API (train_native.py / "
+            "DistributedDataParallel); the managed Accelerator path runs "
+            "pure data parallelism"
+        )
 
     # Managed path: world size comes from the runtime, not config — but honor
     # the dev-mode CPU world request like the native entrypoint does, and a

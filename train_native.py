@@ -36,7 +36,6 @@ from tpuddp.data import (
 from tpuddp.data.transforms import make_eval_transform, make_train_augment
 from tpuddp.models import load_model
 from tpuddp.parallel.ddp import DistributedDataParallel
-from tpuddp.parallel.mesh import data_mesh
 from tpuddp.parallel.spawn import run_ddp_training
 from tpuddp.training.loop import run_training_loop
 
@@ -44,7 +43,8 @@ logging.basicConfig(level=logging.INFO, format="%(message)s")
 
 
 def basic_ddp_training_loop(
-    rank, world_size, save_dir, optional_args, training=None, observability=None
+    rank, world_size, save_dir, optional_args, training=None, observability=None,
+    parallel=None,
 ):
     """Per-process worker — parity with the reference's
     ``basic_DDP_training_loop`` (multi-GPU-training-torch.py:228-266). The
@@ -56,15 +56,13 @@ def basic_ddp_training_loop(
     # across ranks (DistributedSampler contract) and independent of model seed.
     key, _base_seed = seeding.set_seed_based_on_rank(rank, training.get("seed"))
 
-    # comm_topology: hierarchical factors the data mesh ("host", "local") so
-    # the comm hooks can split the intra-/inter-host hops (parallel/comm.py)
+    # Mesh: the ``parallel`` block factors the world into the 2-D
+    # ("data", "model") grid (config.mesh_from; model=1 is exactly today's
+    # flat mesh), and comm_topology: hierarchical factors the data axis
+    # ("host", "local") so the comm hooks can split the intra-/inter-host
+    # hops (parallel/comm.py). Bad factorizations refuse at mesh_from.
     comm_topology = str(training.get("comm_topology") or "flat")
-    if comm_topology == "hierarchical":
-        from tpuddp.parallel.mesh import hierarchical_mesh
-
-        mesh = hierarchical_mesh(world_size)
-    else:
-        mesh = data_mesh(world_size)
+    mesh = cfg_lib.mesh_from(parallel, world_size, comm_topology=comm_topology)
 
     # Data + model (reference :237-238); synthetic fallback keeps the tutorial
     # runnable with no dataset staged (zero-egress environments).
@@ -93,13 +91,19 @@ def basic_ddp_training_loop(
     size = training.get("image_size")
     mean, std = norm_stats_for(training)
     cdtype = compute_dtype_for(training)
-    augment = make_train_augment(
-        size=size, flip=flip_for(training), mean=mean, std=std,
-        compute_dtype=cdtype,
-    )
-    eval_transform = make_eval_transform(
-        size=size, mean=mean, std=std, compute_dtype=cdtype
-    )
+    is_token_model = str(training.get("model") or "").startswith("transformer")
+    if is_token_model:
+        # token models take int sequences: the image augment/normalize
+        # pipeline does not apply (and the TP wrap refuses it outright)
+        augment = eval_transform = None
+    else:
+        augment = make_train_augment(
+            size=size, flip=flip_for(training), mean=mean, std=std,
+            compute_dtype=cdtype,
+        )
+        eval_transform = make_eval_transform(
+            size=size, mean=mean, std=std, compute_dtype=cdtype
+        )
 
     # Model, optionally fine-tuning from a torch checkpoint on disk — the
     # reference's central pretrained-AlexNet workflow (data_and_toy_model.py:41-45).
@@ -232,6 +236,7 @@ if __name__ == "__main__":
             basic_ddp_training_loop,
             training=training,
             observability=cfg_lib.observability_config(settings),
+            parallel=cfg_lib.parallel_config(settings),
         ),
         world_size,
         out_dir,
