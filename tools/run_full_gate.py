@@ -100,7 +100,21 @@ history.jsonl is then independently re-validated with tpuddp_inspect —
 a controller that lets co-scheduled jobs corrupt each other's channels
 fails here.
 
-Observability gate (last): tools/bench_trend.py across the committed
+Tracing gate (after the observability gate, last): the causal tracing
+plane (ISSUE 15, tpuddp/observability/trace.py). A traced training dryrun
+(``observability.tracing: true``) and an untraced same-seed twin must
+produce IDENTICAL loss trajectories (train/test loss + accuracy per epoch,
+compared bitwise on the serialized values) — tracing changes zero
+semantics; the traced run must leave a schema-v9-valid ``trace_train.json``
+whose span tree nests (no orphan parent_ids — enforced by the validator
+whenever the ring dropped nothing) and a run_meta carrying the ``tracing``
+provenance block, while the untraced twin must leave NO trace artifact and
+a null ``tracing`` field. Then a traced serving sweep (``python -m
+tpuddp.serving --demo`` with tracing on) must drain to a schema-valid
+``trace_serving.json`` with request/admission/queue_wait span trees and a
+``trace_summary`` history row.
+
+Observability gate: tools/bench_trend.py across the committed
 BENCH_r*.json artifacts (a >10% regression of any same-device best row
 fails), a live exporter scrape (a serving engine with the
 observability.exporter block must answer /healthz + the serving /metrics
@@ -1041,6 +1055,170 @@ def _observability_gate(env) -> int:
     return 0
 
 
+def _tracing_gate(env) -> int:
+    """Causal-tracing leg (ISSUE 15): (a) a traced training dryrun vs an
+    untraced same-seed twin — identical loss trajectories, a valid
+    trace_train.json with correctly-nesting spans on the traced side, no
+    artifact on the untraced side; (b) a traced serving demo draining to a
+    valid trace_serving.json with request-tree spans."""
+    import json
+
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    worker = os.path.join(REPO, "tests", "_chaos_train_worker.py")
+    with tempfile.TemporaryDirectory(prefix="tpuddp_trace_gate_") as tmp:
+        base_env = dict(env)
+        base_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        # -- leg a: traced vs untraced training twins (same seed 0)
+        dirs = {}
+        for mode, obs in (("traced", '{"tracing": true}'), ("plain", "null")):
+            out_dir = os.path.join(tmp, mode)
+            os.makedirs(out_dir)
+            dirs[mode] = out_dir
+            worker_env = dict(base_env)
+            worker_env["TPUDDP_CHAOS_OBS"] = obs
+            rc = subprocess.call(
+                [sys.executable, "-u", worker, out_dir, "2"],
+                cwd=REPO, env=worker_env,
+            )
+            if rc != 0:
+                print(f"tracing gate: {mode} dryrun exited {rc}",
+                      file=sys.stderr)
+                return rc or 1
+        trajectories = {}
+        metas = {}
+        for mode, out_dir in dirs.items():
+            with open(os.path.join(out_dir, "history.jsonl")) as f:
+                records = [json.loads(l) for l in f if l.strip()]
+            metas[mode] = next(r for r in records if r["type"] == "run_meta")
+            trajectories[mode] = [
+                (r["epoch"], r["train_loss"], r["test_loss"],
+                 r["test_accuracy"])
+                for r in records if r["type"] == "epoch"
+            ]
+        if trajectories["traced"] != trajectories["plain"]:
+            print("tracing gate: traced and untraced loss trajectories "
+                  f"differ:\n  traced: {trajectories['traced']}\n  plain:  "
+                  f"{trajectories['plain']}", file=sys.stderr)
+            return 1
+        if not isinstance(metas["traced"].get("tracing"), dict):
+            print("tracing gate: traced run_meta carries no tracing block",
+                  file=sys.stderr)
+            return 1
+        if metas["plain"].get("tracing") is not None:
+            print("tracing gate: UNTRACED run_meta carries a tracing block",
+                  file=sys.stderr)
+            return 1
+        trace_art = os.path.join(dirs["traced"], "trace_train.json")
+        if not os.path.exists(trace_art):
+            print("tracing gate: traced run left no trace_train.json",
+                  file=sys.stderr)
+            return 1
+        if os.path.exists(os.path.join(dirs["plain"], "trace_train.json")):
+            print("tracing gate: UNTRACED run left a trace_train.json",
+                  file=sys.stderr)
+            return 1
+        for target in (trace_art, os.path.join(dirs["traced"], "history.jsonl")):
+            rc = subprocess.call(
+                [sys.executable, inspect, "--validate", target],
+                cwd=REPO, env=env,
+            )
+            if rc != 0:
+                print(f"tracing gate: {os.path.basename(target)} failed "
+                      "validation", file=sys.stderr)
+                return rc
+        with open(trace_art) as f:
+            payload = json.load(f)
+        spans = [
+            e for e in payload["traceEvents"]
+            if isinstance(e, dict) and e.get("ph") == "X"
+        ]
+        kinds = {e.get("cat") for e in spans}
+        for required in ("epoch", "stage", "dispatch", "readback"):
+            if required not in kinds:
+                print(f"tracing gate: training trace has no {required!r} "
+                      f"spans (saw {sorted(kinds)})", file=sys.stderr)
+                return 1
+        if payload["tpuddp"]["dropped"] == 0:
+            # the validator already enforced no-orphans; double-check here
+            # so the gate's contract is explicit even if the validator drifts
+            ids = {e["args"]["span_id"] for e in spans}
+            orphans = [
+                e for e in spans
+                if e["args"].get("parent_id") is not None
+                and e["args"]["parent_id"] not in ids
+            ]
+            if orphans:
+                print(f"tracing gate: {len(orphans)} orphan parent_id(s) in "
+                      "the training trace", file=sys.stderr)
+                return 1
+        # -- leg b: traced serving demo
+        serve_dir = os.path.join(tmp, "serve")
+        os.makedirs(serve_dir)
+        settings = os.path.join(tmp, "settings.yaml")
+        with open(settings, "w") as f:
+            f.write(
+                "out_dir: %s\n"
+                "serving:\n"
+                "  num_replicas: 2\n"
+                "  max_batch_size: 8\n"
+                "  stats_window: 16\n"
+                "observability:\n"
+                "  tracing: true\n" % serve_dir
+            )
+        rc = subprocess.call(
+            [
+                sys.executable, "-u", "-m", "tpuddp.serving",
+                "--settings", settings, "--demo", "24",
+            ],
+            cwd=REPO, env=base_env, stdout=subprocess.DEVNULL,
+        )
+        if rc != 0:
+            print(f"tracing gate: traced serving demo exited {rc}",
+                  file=sys.stderr)
+            return rc
+        serve_trace = os.path.join(serve_dir, "trace_serving.json")
+        if not os.path.exists(serve_trace):
+            print("tracing gate: serving drain left no trace_serving.json",
+                  file=sys.stderr)
+            return 1
+        for target in (serve_trace, os.path.join(serve_dir, "history.jsonl")):
+            rc = subprocess.call(
+                [sys.executable, inspect, "--validate", target],
+                cwd=REPO, env=env,
+            )
+            if rc != 0:
+                print(f"tracing gate: {os.path.basename(target)} failed "
+                      "validation", file=sys.stderr)
+                return rc
+        with open(serve_trace) as f:
+            kinds = {
+                e.get("cat")
+                for e in json.load(f)["traceEvents"]
+                if isinstance(e, dict) and e.get("ph") == "X"
+            }
+        for required in ("request", "admission", "queue_wait"):
+            if required not in kinds:
+                print(f"tracing gate: serving trace has no {required!r} "
+                      f"spans (saw {sorted(kinds)})", file=sys.stderr)
+                return 1
+        with open(os.path.join(serve_dir, "history.jsonl")) as f:
+            has_summary = any(
+                json.loads(l).get("type") == "trace_summary"
+                for l in f if l.strip()
+            )
+        if not has_summary:
+            print("tracing gate: serving history has no trace_summary row",
+                  file=sys.stderr)
+            return 1
+    print("tracing gate: traced/untraced twins bitwise-equal, both trace "
+          "artifacts schema-v9 valid with nesting span trees")
+    return 0
+
+
 def main(argv=None):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")  # the full gate never needs a real TPU
@@ -1080,7 +1258,10 @@ def main(argv=None):
     rc = _fleet_gate(env)
     if rc != 0:
         return rc
-    return _observability_gate(env)
+    rc = _observability_gate(env)
+    if rc != 0:
+        return rc
+    return _tracing_gate(env)
 
 
 if __name__ == "__main__":

@@ -36,6 +36,7 @@ object), not by name, so renamed artifacts still inspect.
 from __future__ import annotations
 
 import argparse
+import collections
 import importlib.util
 import json
 import os
@@ -59,9 +60,11 @@ def _load_schema():
 def _detect_kind(path: str) -> str:
     """'bench' (ONE JSON object with metric+configs — possibly
     pretty-printed across lines), 'flight' (one object stamped
-    type=flight_recording — the crash post-mortem sidecar), or 'history'
-    (a JSONL record stream, which fails whole-file json.load with 'Extra
-    data' beyond one record)."""
+    type=flight_recording — the crash post-mortem sidecar), 'trace' (one
+    object with traceEvents + a tpuddp provenance block — the causal
+    tracing plane's Chrome-trace artifact), or 'history' (a JSONL record
+    stream, which fails whole-file json.load with 'Extra data' beyond one
+    record)."""
     try:
         with open(path) as f:
             obj = json.load(f)
@@ -69,6 +72,8 @@ def _detect_kind(path: str) -> str:
         return "history"
     if isinstance(obj, dict) and obj.get("type") == "flight_recording":
         return "flight"
+    if isinstance(obj, dict) and "traceEvents" in obj:
+        return "trace"
     if isinstance(obj, dict) and "configs" in obj and "metric" in obj:
         return "bench"
     return "history"
@@ -356,6 +361,18 @@ def summarize_history(path: str) -> None:
             f"{k}={v}" for k, v in sur_counts.items() if v
         ))
 
+    # tracing digest (schema v9): the drain-time trace_summary rows — span
+    # counts, ring drops, and the single slowest span per traced writer
+    for ts in (r for r in records if r.get("type") == "trace_summary"):
+        slowest = (ts.get("slowest") or [{}])[0]
+        print(f"\ntracing: role={ts.get('role')} spans={ts.get('spans')} "
+              f"dropped={ts.get('dropped')} open={ts.get('open_spans')} "
+              f"by_kind={ts.get('by_kind')}")
+        if slowest:
+            print(f"  slowest span: {slowest.get('name')} "
+                  f"({slowest.get('kind')}) "
+                  f"{_fmt(slowest.get('duration_ms'), 3)} ms")
+
     if events:
         print(f"\nevents ({len(events)}):")
         for ev in events:
@@ -411,6 +428,62 @@ def summarize_flight(path: str) -> None:
                 if k not in ("type", "schema_version", "event")
             }
             print(f"    [{ev.get('epoch', '-')}] {ev.get('event')}: {fields}")
+
+
+def summarize_trace(path: str) -> None:
+    """Pretty-print a trace_<role>.json artifact: provenance, per-kind time
+    share, and the slowest-span table (the ``trace`` subcommand's summary —
+    pure python, no accelerator runtime needed)."""
+    with open(path) as f:
+        payload = json.load(f)
+    meta = payload.get("tpuddp") or {}
+    print(f"trace: role={meta.get('role')} process={meta.get('process_index')} "
+          f"spans={meta.get('spans')} dropped={meta.get('dropped')} "
+          f"open={meta.get('open_spans')} traces={meta.get('traces')} "
+          f"capacity={meta.get('capacity')}")
+    clock = meta.get("clock_sync") or {}
+    if clock:
+        print(f"  clock_sync: unix_us={clock.get('unix_us')} "
+              f"perf_ns={clock.get('perf_ns')}")
+    spans = [
+        e for e in (payload.get("traceEvents") or [])
+        if isinstance(e, dict) and e.get("ph") == "X"
+    ]
+    # per-kind time share: where the traced wall time went, by span kind.
+    # Kinds NEST (a stage span lives inside its epoch span), so shares can
+    # exceed 100% of any one kind — the table answers "which kind is the
+    # fat one", not "how do these partition the run".
+    by_kind = collections.Counter()
+    counts = collections.Counter()
+    for e in spans:
+        kind = e.get("cat") or "?"
+        by_kind[kind] += float(e.get("dur") or 0.0)
+        counts[kind] += 1
+    total = sum(by_kind.values())
+    if by_kind and total > 0:
+        print(f"\nper-kind device-free host time ({total / 1e3:.1f} ms "
+              "summed across nested spans):")
+        rows = [
+            [k, str(counts[k]), f"{d / 1e3:.1f}", f"{100 * d / total:.1f}%"]
+            for k, d in by_kind.most_common()
+        ]
+        _print_table(rows, ["kind", "spans", "ms", "share"])
+    slowest = meta.get("slowest") or []
+    if slowest:
+        print(f"\nslowest spans (top {len(slowest)}):")
+        rows = [
+            [
+                str(r.get("name")), str(r.get("kind")),
+                _fmt(r.get("duration_ms"), 3),
+            ]
+            for r in slowest
+        ]
+        _print_table(rows, ["name", "kind", "ms"])
+    opens = [e for e in spans if (e.get("args") or {}).get("open")]
+    if opens:
+        print(f"\nstill-open at export ({len(opens)}):")
+        for e in opens:
+            print(f"  {e.get('name')} ({e.get('cat')})")
 
 
 def summarize_bench(path: str) -> None:
@@ -517,9 +590,18 @@ def summarize_bench(path: str) -> None:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `tpuddp_inspect.py trace <path>` — the explicit trace subcommand:
+    # validates the artifact against schema v9 and prints the slowest-span
+    # table + per-kind time share (content detection still recognizes a
+    # trace artifact passed as a bare path, so both spellings work)
+    trace_mode = bool(argv) and argv[0] == "trace"
+    if trace_mode:
+        argv = argv[1:]
     parser = argparse.ArgumentParser(
-        description="Validate/summarize a tpuddp history.jsonl or "
-        "bench_results.json artifact.",
+        description="Validate/summarize a tpuddp history.jsonl, "
+        "bench_results.json, flightrec_*.json, or trace_<role>.json "
+        "artifact ('trace <path>' forces the trace reader).",
     )
     parser.add_argument("path", help="artifact to inspect")
     parser.add_argument(
@@ -537,11 +619,13 @@ def main(argv=None) -> int:
         return 2
 
     schema = _load_schema()
-    kind = _detect_kind(args.path)
+    kind = "trace" if trace_mode else _detect_kind(args.path)
     if kind == "bench":
         errors, n = schema.validate_bench_file(args.path)
     elif kind == "flight":
         errors, n = schema.validate_flight_file(args.path)
+    elif kind == "trace":
+        errors, n = schema.validate_trace_file(args.path)
     else:
         errors, n = schema.validate_history_file(args.path)
 
@@ -561,6 +645,8 @@ def main(argv=None) -> int:
         summarize_bench(args.path)
     elif kind == "flight":
         summarize_flight(args.path)
+    elif kind == "trace":
+        summarize_trace(args.path)
     elif args.events:
         for r in _read_history(args.path):
             if r.get("event"):

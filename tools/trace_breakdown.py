@@ -4,72 +4,125 @@ time breakdown (the analysis behind BASELINE.md's MFU section).
 Usage:
     TPUDDP_PROFILE=<dir> python train_native.py --settings_file ...   # capture
     python tools/trace_breakdown.py <dir>                              # analyze
+    python tools/trace_breakdown.py <dir> --merge-host <trace_role.json> \
+        --out merged.json                                              # overlay
 
 Works on the trace-viewer JSON the profiler writes (vm.trace.json.gz); does
 not need the tensorboard profile plugin (whose converter does not match the
 installed TF build). Buckets each device op by its `source`/`tf_op`/shape
 metadata into: matmul/conv compute, optimizer+weight HBM traffic,
 augment/resize, copies/slices, other elementwise.
+
+Robustness contract: ALL ``*.trace.json.gz`` capture files under the dir are
+merged (a multi-step-window run writes one per capture; picking only the
+last silently dropped the rest), and events with missing metadata — bare ops
+without ``args``, thread-name records without a name, X events without a
+``dur`` — are tolerated, never a KeyError.
+
+``--merge-host`` overlays a host-side span artifact (``trace_<role>.json``,
+tpuddp/observability/trace.py — the causal tracing plane's export) onto the
+device timeline and writes one merged Chrome-trace JSON loadable in
+Perfetto: device XLA ops and host epoch/stage/dispatch/readback (or
+request/prefill/decode-step) spans on adjacent tracks. Host spans carry
+unix-epoch timestamps through their artifact's ``clock_sync`` anchor; device
+captures use the profiler's own epoch, so alignment defaults to
+``--align earliest`` (shift the host timeline so both start together) —
+pass ``--align wall`` only when the device trace is known to be
+unix-anchored, or ``--offset-us`` to apply a measured skew (e.g. the
+difference of two hosts' heartbeat-shard ``clock`` anchors,
+tpuddp/observability/aggregate.py).
 """
 
 from __future__ import annotations
 
+import argparse
 import collections
 import glob
 import gzip
 import json
+import re
 import sys
 
 
-def load_ops(trace_dir: str):
+def _capture_files(trace_dir: str):
     pattern = f"{trace_dir}/**/*.trace.json.gz"
     files = sorted(glob.glob(pattern, recursive=True))
     if not files:
         raise SystemExit(f"no *.trace.json.gz under {trace_dir}")
-    with gzip.open(files[-1]) as fh:
+    return files
+
+
+def _load_events(path: str):
+    with gzip.open(path) as fh:
         data = json.load(fh)
-    events = data["traceEvents"]
-    tids = {}
-    device_pids = set()
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "thread_name":
-            tids[(e["pid"], e["tid"])] = e["args"]["name"]
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            if "TPU" in e["args"].get("name", ""):
-                device_pids.add(e["pid"])
-    ops = [
-        e
-        for e in events
-        if e.get("ph") == "X"
-        and e["pid"] in device_pids
-        and tids.get((e["pid"], e["tid"])) == "XLA Ops"
-        and not e["name"].startswith("while")
-    ]
-    if len(events) >= 900_000:
-        # The trace-viewer JSON export caps around 1M events; a long epoch's
-        # host python spans can crowd device ops out — completely (zero
-        # device rows) or partially (an understated breakdown). With no way
-        # to tell WHAT got cut, refuse when no device rows survived and warn
-        # loudly otherwise: validate a surviving breakdown against known
-        # model FLOPs (the BASELINE.md cross-check) before trusting it.
-        if not ops:
+    events = data.get("traceEvents")
+    if not isinstance(events, list):
+        print(f"WARNING: {path} has no traceEvents list; skipped",
+              file=sys.stderr)
+        return []
+    return events
+
+
+def load_ops(trace_dir: str):
+    """Device 'XLA Ops' events from EVERY capture file under ``trace_dir``
+    (merged — a step-window run writes one file per capture and a breakdown
+    over only the newest understates everything else). Tolerant of bare
+    ops: missing ``args``/``name``/``dur`` metadata never raises."""
+    all_ops = []
+    capped_files = []
+    for path in _capture_files(trace_dir):
+        events = _load_events(path)
+        # the exporter's ~1M-event cap applies PER CAPTURE FILE: three
+        # healthy 350k-event captures are not "over the cap" just because
+        # they sum past it
+        if len(events) >= 900_000:
+            capped_files.append(path)
+        tids = {}
+        device_pids = set()
+        for e in events:
+            if not isinstance(e, dict):
+                continue
+            args = e.get("args") or {}
+            if e.get("ph") == "M" and e.get("name") == "thread_name":
+                # bare metadata (no args.name) is tolerated, not a KeyError
+                tids[(e.get("pid"), e.get("tid"))] = args.get("name", "")
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                if "TPU" in (args.get("name") or ""):
+                    device_pids.add(e.get("pid"))
+        all_ops.extend(
+            e
+            for e in events
+            if isinstance(e, dict)
+            and e.get("ph") == "X"
+            and e.get("pid") in device_pids
+            and tids.get((e.get("pid"), e.get("tid"))) == "XLA Ops"
+            and not (e.get("name") or "").startswith("while")
+        )
+    if capped_files:
+        # The trace-viewer JSON export caps around 1M events per file; a
+        # long epoch's host python spans can crowd device ops out —
+        # completely (zero device rows) or partially (an understated
+        # breakdown). With no way to tell WHAT got cut, refuse when no
+        # device rows survived and warn loudly otherwise: validate a
+        # surviving breakdown against known model FLOPs (the BASELINE.md
+        # cross-check) before trusting it.
+        if not all_ops:
             raise SystemExit(
-                f"trace has {len(events)} events but zero device 'XLA Ops' — "
-                "the exporter's ~1M-event cap crowded the device rows out. "
-                "Capture a SHORTER window (fewer steps, e.g. "
-                "training.synthetic_n: [2048, 256]) and re-run."
+                f"{len(capped_files)} capture file(s) sit at the exporter's "
+                "~1M-event cap and zero device 'XLA Ops' survived — the cap "
+                "crowded the device rows out. Capture a SHORTER window "
+                "(fewer steps, e.g. training.synthetic_n: [2048, 256]) and "
+                "re-run."
             )
         print(
-            f"WARNING: trace has {len(events)} events — at the exporter's "
-            "~1M-event cap, so rows may be truncated. Cross-check the TF "
+            f"WARNING: {len(capped_files)} capture file(s) at the exporter's "
+            "~1M-event cap — rows may be truncated. Cross-check the TF "
             "totals against the model's known FLOPs before trusting this "
             "breakdown (or capture a shorter window).",
             file=sys.stderr,
         )
-    return ops
+    return all_ops
 
-
-import re
 
 _SHAPE_TOKEN = re.compile(r"\b(?:f32|bf16|f16)\[[\d,]+\]")
 
@@ -107,11 +160,12 @@ def _looks_like_optimizer_update(shape_with_layout: str) -> bool:
 
 def categorize(e) -> str:
     a = e.get("args") or {}
-    src, tf_op = a.get("source", ""), a.get("tf_op", "")
+    src, tf_op = a.get("source") or "", a.get("tf_op") or ""
+    name = e.get("name") or ""
     if "transforms.py" in src or "_resize" in tf_op:
         return "augment/resize"
     if "optim" in src or _looks_like_optimizer_update(
-        a.get("shape_with_layout", "")
+        a.get("shape_with_layout") or ""
     ):
         # these fused ops contain BOTH the weight-grad dot/conv and the
         # optimizer state update; their byte/flop ratio tells which side
@@ -119,19 +173,21 @@ def categorize(e) -> str:
         return "weight-grad + optimizer (fused)"
     if "conv" in tf_op or "dot_general" in tf_op:
         return "fwd/input-grad conv+matmul"
-    if "copy" in e["name"] or "slice" in e["name"]:
+    if "copy" in name or "slice" in name:
         return "copies/slices"
     return "other elementwise"
 
 
-def main(trace_dir: str, steps: int = 0):
+def breakdown(trace_dir: str, steps: int = 0) -> None:
     ops = load_ops(trace_dir)
-    total = sum(e["dur"] for e in ops)
+    total = sum(e.get("dur") or 0 for e in ops)
+    if total <= 0:
+        raise SystemExit("no device op time recorded (all durations missing)")
     by = collections.Counter()
     flops = collections.Counter()
     for e in ops:
         k = categorize(e)
-        by[k] += e["dur"]
+        by[k] += e.get("dur") or 0
         flops[k] += float((e.get("args") or {}).get("model_flops", 0) or 0)
     per_step = f" ({total / steps / 1e3:.2f} ms/step)" if steps else ""
     print(f"device op time {total / 1e3:.1f} ms{per_step}")
@@ -142,7 +198,130 @@ def main(trace_dir: str, steps: int = 0):
         )
 
 
+def merge_host(
+    trace_dir: str,
+    host_path: str,
+    out_path: str,
+    align: str = "earliest",
+    offset_us: float = 0.0,
+) -> None:
+    """Overlay the host span artifact onto the device timeline: one merged
+    Chrome-trace JSON with the device events verbatim and the host spans on
+    their own process rows (pids offset past the device pids so tracks
+    never collide). ``align``:
+
+    - ``earliest`` (default) — shift the host timeline so the earliest host
+      span starts where the earliest device event does (the device
+      profiler's clock epoch is not unix time, so absolute alignment is
+      unknowable without a shared anchor);
+    - ``wall`` — trust both timelines as-is (host spans are unix-µs through
+      their ``clock_sync`` anchor; correct only for unix-anchored device
+      captures).
+
+    ``offset_us`` is added to every host timestamp AFTER alignment — the
+    measured-skew knob (difference of two hosts' heartbeat-shard ``clock``
+    anchors)."""
+    device_events = []
+    for path in _capture_files(trace_dir):
+        device_events.extend(
+            e for e in _load_events(path) if isinstance(e, dict)
+        )
+    try:
+        with open(host_path) as f:
+            host = json.load(f)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"cannot parse host trace {host_path}: {e}")
+    host_events = [
+        e for e in (host.get("traceEvents") or []) if isinstance(e, dict)
+    ]
+    if not host_events:
+        raise SystemExit(f"{host_path} carries no traceEvents")
+    # keep host tracks clear of device pids
+    device_pids = {
+        e.get("pid") for e in device_events if e.get("pid") is not None
+    }
+    numeric = [p for p in device_pids if isinstance(p, (int, float))]
+    pid_base = int(max(numeric) + 1000) if numeric else 1_000_000
+    shift = float(offset_us)
+    if align == "earliest":
+        dev_ts = [
+            e["ts"] for e in device_events
+            if e.get("ph") == "X" and isinstance(e.get("ts"), (int, float))
+        ]
+        host_ts = [
+            e["ts"] for e in host_events
+            if e.get("ph") == "X" and isinstance(e.get("ts"), (int, float))
+        ]
+        if dev_ts and host_ts:
+            shift += min(dev_ts) - min(host_ts)
+    elif align != "wall":
+        raise SystemExit(f"unknown --align {align!r} (earliest|wall)")
+    merged = list(device_events)
+    for e in host_events:
+        e = dict(e)
+        if isinstance(e.get("pid"), (int, float)):
+            e["pid"] = pid_base + int(e["pid"])
+        if isinstance(e.get("ts"), (int, float)):
+            e["ts"] = e["ts"] + shift
+        merged.append(e)
+    payload = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "tpuddp_merge": {
+            "host_artifact": host_path,
+            "host_role": (host.get("tpuddp") or {}).get("role"),
+            "align": align,
+            "host_shift_us": round(shift, 3),
+        },
+    }
+    opener = gzip.open if out_path.endswith(".gz") else open
+    with opener(out_path, "wt") as f:
+        json.dump(payload, f)
+    print(
+        f"merged {len(device_events)} device event(s) + {len(host_events)} "
+        f"host event(s) -> {out_path} (host timeline shifted "
+        f"{shift / 1e3:.3f} ms, align={align})"
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Device-trace breakdown + host-span overlay.",
+    )
+    parser.add_argument("trace_dir", help="profiler capture dir")
+    parser.add_argument(
+        "steps", nargs="?", type=int, default=0,
+        help="steps covered by the capture (prints ms/step)",
+    )
+    parser.add_argument(
+        "--merge-host", metavar="TRACE_JSON",
+        help="host span artifact (trace_<role>.json) to overlay onto the "
+        "device timeline",
+    )
+    parser.add_argument(
+        "--out", default=None,
+        help="merged trace output path (default: merged_trace.json in the "
+        "capture dir; .gz writes gzip)",
+    )
+    parser.add_argument(
+        "--align", choices=("earliest", "wall"), default="earliest",
+        help="host-vs-device clock alignment (see module doc)",
+    )
+    parser.add_argument(
+        "--offset-us", type=float, default=0.0,
+        help="extra host-timeline shift in µs (measured cross-host skew)",
+    )
+    args = parser.parse_args(argv)
+    if args.merge_host:
+        out = args.out or f"{args.trace_dir}/merged_trace.json"
+        merge_host(
+            args.trace_dir, args.merge_host, out,
+            align=args.align, offset_us=args.offset_us,
+        )
+        return 0
+    breakdown(args.trace_dir, args.steps)
+    return 0
+
+
 if __name__ == "__main__":
-    if len(sys.argv) < 2:
-        raise SystemExit(__doc__)
-    main(sys.argv[1], int(sys.argv[2]) if len(sys.argv) > 2 else 0)
+    sys.exit(main())

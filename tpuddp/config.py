@@ -262,6 +262,15 @@ OBSERVABILITY_DEFAULTS = {
     # flightrec_<reason>.json on abnormal exits (preempt 75 / watchdog 76 /
     # desync 77 / unhandled exception / serving dispatch death)
     "flight_capacity": 64,  # ring length per record kind
+    "tracing": False,  # causal tracing plane (observability/trace.py):
+    # host-side span trees through training (epoch/stage/dispatch/
+    # collective/readback), serving (request/admission/queue_wait/prefill/
+    # decode_step + failover links) and the fleet controller, exported as a
+    # Perfetto-loadable trace_<role>.json at drain and served live on the
+    # exporter's /trace endpoint. Pure host bracketing: zero new device
+    # fences, HLO and loss trajectory identical tracing on/off.
+    "trace_capacity": 4096,  # completed-span ring length per process
+    # (oldest spans dropped past it, counted in the trace_summary record)
 }
 
 

@@ -40,6 +40,7 @@ from typing import Callable, Dict, List, Optional
 
 from tpuddp.fleet.scheduler import JobView, Plan, plan_fleet
 from tpuddp.fleet.spec import FleetAdmissionError, JobSpec
+from tpuddp.observability import trace as trace_lib
 from tpuddp.resilience.preemption import preemption_grace_seconds
 from tpuddp.resilience.supervisor import (
     WORLD_ENV,
@@ -106,6 +107,7 @@ class ManagedJob:
         self.spec = spec
         self.arrival = arrival
         self.run_dir = run_dir
+        self.trace_span = None  # the job's lifecycle span (tracing on only)
         self.state = QUEUED
         self.desired = spec.initial_desired()
         self.slice: Optional[tuple] = None
@@ -160,7 +162,12 @@ class FleetController:
         env: Optional[Dict[str, str]] = None,
         drain_grace: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
+        observability: Optional[dict] = None,
     ):
+        """``observability``: the live-plane block (config shape); today the
+        controller consumes only its ``tracing`` knobs — one job-lifecycle
+        span per submitted job (start/resize/preempt action children),
+        exported as ``trace_fleet.json`` at shutdown."""
         if pool_size < 1:
             raise ValueError(f"pool_size must be >= 1, got {pool_size}")
         self.pool_size = int(pool_size)
@@ -177,6 +184,12 @@ class FleetController:
         self.jobs: Dict[str, ManagedJob] = {}
         self._arrivals = 0
         self.last_plan: Optional[Plan] = None
+        from tpuddp import config as cfg_lib
+
+        self.tracer = trace_lib.tracer_from_config(
+            cfg_lib.resolve_observability(observability), "fleet",
+            run_dir=fleet_dir,
+        )
         os.makedirs(os.path.join(fleet_dir, "jobs"), exist_ok=True)
 
     # -------------------------------------------------------------- admit --
@@ -205,6 +218,15 @@ class FleetController:
             run_dir = os.path.join(self.fleet_dir, "jobs", spec.name)
             os.makedirs(run_dir, exist_ok=True)
             job = ManagedJob(spec, self._arrivals, run_dir)
+            job.trace_span = self.tracer.start_span(
+                f"job {spec.name}", trace_lib.KIND_JOB, tid="jobs",
+                attrs={
+                    "kind": spec.kind,
+                    "priority": spec.priority,
+                    "min_world": spec.min_world,
+                    "max_world": spec.max_world,
+                },
+            )
             self._arrivals += 1
             self.jobs[spec.name] = job
             logger.info(
@@ -240,6 +262,10 @@ class FleetController:
             ),
         )
         job.state = RUNNING
+        self.tracer.end_span(self.tracer.start_span(
+            "start", trace_lib.KIND_ACTION, parent=job.trace_span,
+            attrs={"world": world},
+        ))
 
         def _supervise():
             rc = job.supervisor.run()
@@ -247,6 +273,10 @@ class FleetController:
                 job.exit_code = rc
                 job.state = (
                     PREEMPTED if job.stopping else (DONE if rc == 0 else FAILED)
+                )
+                self.tracer.end_span(
+                    job.trace_span, state=job.state, exit_code=rc,
+                    resizes=job.resizes,
                 )
                 logger.info(
                     "fleet: %s finished supervision: state=%s rc=%s",
@@ -320,6 +350,10 @@ class FleetController:
         )
         # retarget FIRST: if the child exits before our SIGTERM lands (or
         # is already draining), the relaunch still gets the new world
+        self.tracer.end_span(self.tracer.start_span(
+            "resize", trace_lib.KIND_ACTION, parent=job.trace_span,
+            attrs={"from_world": job.supervisor.world_size, "to_world": world},
+        ))
         job.supervisor.set_world(world)
         job.resizes += 1
         self._signal_drain(job)
@@ -329,6 +363,10 @@ class FleetController:
             return
         job.stopping = True
         job.preempted_by = by
+        self.tracer.end_span(self.tracer.start_span(
+            "preempt", trace_lib.KIND_ACTION, parent=job.trace_span,
+            attrs={"by": by},
+        ))
         logger.warning(
             "fleet: preempting %s%s — drain first, SIGKILL only after the "
             "grace window", job.spec.name, f" (displaced by {by})" if by else "",
@@ -467,6 +505,9 @@ class FleetController:
             if job.state == QUEUED:
                 job.state = PREEMPTED
                 job.stopping = True
+                self.tracer.end_span(
+                    job.trace_span, state=PREEMPTED, cancelled=True
+                )
                 return
             if job.state == RUNNING:
                 self._preempt(job)
@@ -505,6 +546,9 @@ class FleetController:
                 if job.state == QUEUED:
                     job.state = PREEMPTED
                     job.stopping = True
+                    self.tracer.end_span(
+                        job.trace_span, state=PREEMPTED, cancelled=True
+                    )
                 elif job.state == RUNNING:
                     self._preempt(job)
         deadline = time.monotonic() + timeout
@@ -517,6 +561,7 @@ class FleetController:
                     if j.thread is not None and j.thread.is_alive()
                 ]
             if not alive:
+                self.tracer.export()
                 return
             time.sleep(0.2)
         for j in alive:  # last resort: the escalation path already SIGKILLed
@@ -524,6 +569,7 @@ class FleetController:
                 "fleet: %s supervisor thread still alive at shutdown "
                 "timeout", j.spec.name,
             )
+        self.tracer.export()
 
     def status(self) -> List[dict]:
         with self._lock:

@@ -27,6 +27,14 @@ stdout.
   channel + the main-process pod aggregator and straggler detector.
 - :mod:`flight`    — the bounded crash flight recorder, dumped to
   ``flightrec_<reason>.json`` on abnormal exit paths.
+- :mod:`trace`     — the causal tracing plane (ISSUE 15): host-side span
+  trees (trace_id / span_id / parent_id, typed kinds, bounded ring with
+  drop accounting) through training (epoch → stage/dispatch/collective/
+  readback), serving (request → admission → queue-wait → prefill →
+  decode-step, failover follow-from links), and the fleet controller;
+  exported as Perfetto-loadable ``trace_<role>.json`` artifacts at drain
+  and served live on the exporter's ``/trace`` endpoint. Default OFF
+  (``observability.tracing``); zero device fences either way.
 """
 
 from tpuddp.observability.aggregate import PodAggregator  # noqa: F401
@@ -67,13 +75,23 @@ from tpuddp.observability.schema import (  # noqa: F401
     validate_history_records,
 )
 from tpuddp.observability.telemetry import RunTelemetry  # noqa: F401
+from tpuddp.observability.trace import (  # noqa: F401
+    NULL_TRACER,
+    SPAN_KINDS,
+    Tracer,
+    tracer_from_config,
+)
 
 __all__ = [
     "CommBytesCounter",
     "FlightRecorder",
     "MetricsExporter",
     "MetricsWriter",
+    "NULL_TRACER",
     "PodAggregator",
+    "SPAN_KINDS",
+    "Tracer",
+    "tracer_from_config",
     "exporter_from_config",
     "PEAK_FLOPS",
     "RECORD_TYPES",

@@ -48,7 +48,14 @@ SHARD_FIELDS = (
 def make_shard(
     live: dict, skipped_steps: int = 0, window_index: Optional[int] = None
 ) -> dict:
-    """Build one host's telemetry shard from a recorder live snapshot."""
+    """Build one host's telemetry shard from a recorder live snapshot.
+
+    ``clock`` is the host's wall<->monotonic anchor (unix µs + the
+    ``perf_counter_ns`` taken beside it) — the cross-host skew signal the
+    tracing plane's merge workflow uses: per-host ``trace_<role>.json``
+    artifacts timestamp spans through their OWN anchor, and differencing
+    two hosts' shard anchors bounds the wall-clock skew between their
+    timelines (tools/trace_breakdown.py --merge-host)."""
     return {
         "window_index": (
             int(window_index)
@@ -62,6 +69,10 @@ def make_shard(
         "skipped_steps": int(skipped_steps or 0),
         "samples_per_sec": live.get("samples_per_sec"),
         "t": time.time(),
+        "clock": {
+            "unix_us": int(time.time() * 1e6),
+            "perf_ns": time.perf_counter_ns(),
+        },
     }
 
 
@@ -169,7 +180,7 @@ class PodAggregator:
                     k: shards[pid].get(k)
                     for k in ("window_index", "epoch", "step",
                               "step_time_ms_p50", "host_stall_ms",
-                              "skipped_steps")
+                              "skipped_steps", "clock")
                 }
                 for pid in sorted(shards)
             },

@@ -15,7 +15,15 @@ config block, default OFF):
   quantile-labeled summaries);
 - ``/healthz``  — ``{"status": "ok", "uptime_s": ...}`` liveness JSON;
 - ``/snapshot`` — the raw merged source dicts as JSON (the machine-readable
-  twin of /metrics, exact values, no text-format rounding).
+  twin of /metrics, exact values, no text-format rounding);
+- ``/trace``    — the causal tracing plane's live view (the last-N completed
+  spans + the open set, observability/trace.py) when a trace source is
+  registered (:meth:`MetricsExporter.set_trace_source`); 404 otherwise.
+
+Responses are always WHOLE: the body is fully rendered before a byte is
+sent (Content-Length framing), and any rendering error returns a complete
+500 — a concurrent writer hammering the sources can never make a scrape
+read a torn or half-written payload.
 
 Sources are zero-arg callables returning ``{series_name: series}`` where a
 series is built with :func:`gauge`/:func:`counter`/:func:`summary`. The
@@ -116,6 +124,9 @@ class MetricsExporter:
         self.run_dir = run_dir
         self.port_filename = port_filename
         self._sources: Dict[str, Callable[[], dict]] = {}
+        # the /trace feed (observability/trace.py Tracer.endpoint_payload);
+        # None = no tracing plane attached, the endpoint answers 404
+        self.trace_source: Optional[Callable[[], dict]] = None
         self._lock = threading.Lock()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -130,6 +141,13 @@ class MetricsExporter:
     def unregister_source(self, name: str) -> None:
         with self._lock:
             self._sources.pop(name, None)
+
+    def set_trace_source(self, fn: Optional[Callable[[], dict]]) -> None:
+        """Attach (or detach, with None) the /trace endpoint's feed — a
+        zero-arg callable returning the span payload dict (the tracer copies
+        its ring under its own lock; serialization happens here)."""
+        with self._lock:
+            self.trace_source = fn
 
     def collect(self) -> Dict[str, dict]:
         """Merge every source's series; a failing source is skipped with a
@@ -246,10 +264,38 @@ class MetricsExporter:
                             exporter.snapshot(), allow_nan=False
                         ).encode()
                         self._send(200, body, "application/json")
+                    elif path == "/trace":
+                        with exporter._lock:
+                            source = exporter.trace_source
+                        if source is None:
+                            self._send(
+                                404, b"tracing not enabled\n", "text/plain"
+                            )
+                        else:
+                            body = json.dumps(
+                                json_sanitize(source()), allow_nan=False
+                            ).encode()
+                            self._send(200, body, "application/json")
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except BrokenPipeError:
                     pass  # client went away mid-response
+                except Exception as e:  # noqa: BLE001 — torn-payload guard
+                    # every body above is FULLY rendered before _send, so a
+                    # rendering error (a source mutated mid-serialize by a
+                    # writer thread, a non-finite leak) lands here with
+                    # nothing on the wire yet — answer with a COMPLETE 500
+                    # instead of a truncated connection the client would
+                    # misread as a torn payload
+                    logger.warning("exporter: scrape failed: %s", e)
+                    try:
+                        self._send(
+                            500,
+                            f"scrape failed: {e}\n".encode(),
+                            "text/plain",
+                        )
+                    except Exception:  # noqa: BLE001 — socket already gone
+                        pass
 
         self._server = ThreadingHTTPServer(
             (self.host, self.requested_port), Handler

@@ -84,6 +84,10 @@ class FlightRecorder:
         }
         self._run_meta: Optional[dict] = None
         self._notes: dict = {}
+        # live-context providers: zero-arg callables sampled AT DUMP TIME
+        # (not at write time) — how the tracing plane embeds its still-open
+        # spans so a crash dump names the exact stage the process died in
+        self._context: Dict[str, callable] = {}
         self.observed = 0
         self.dumped: Dict[str, str] = {}  # reason -> path (idempotence)
 
@@ -108,9 +112,29 @@ class FlightRecorder:
         with self._lock:
             self._notes.update(fields)
 
+    def add_context(self, name: str, fn) -> None:
+        """Register a live-context provider: ``fn()`` is called at dump
+        time and its result lands under ``notes[name]``. Best-effort by the
+        dump contract — a raising provider records its failure string
+        instead of blocking the exit path."""
+        with self._lock:
+            self._context[name] = fn
+
     # -------------------------------------------------------------- dump --
     def payload(self, reason: str) -> dict:
         with self._lock:
+            providers = list(self._context.items())
+        notes = {}
+        for name, fn in providers:
+            # sampled OUTSIDE self._lock: a provider takes its own lock
+            # (the tracer's), and holding both here would pin a lock order
+            # on every future provider
+            try:
+                notes[name] = fn()
+            except Exception as e:  # noqa: BLE001 — never block an exit path
+                notes[name] = f"<context provider failed: {e}>"
+        with self._lock:
+            notes.update(self._notes)
             records = {t: list(ring) for t, ring in self._rings.items()}
             return json_sanitize({
                 "type": schema.FLIGHT_TYPE,
@@ -122,7 +146,7 @@ class FlightRecorder:
                 "observed_records": self.observed,
                 "counts": {t: len(r) for t, r in records.items()},
                 "run_meta": self._run_meta,
-                "notes": dict(self._notes),
+                "notes": notes,
                 "records": records,
             })
 

@@ -56,7 +56,15 @@ tpuddp/parallel/mesh2d.py): ``data``/``model`` axis widths plus the
 a reader of a v8 header can tell a 4-chip pure-DP run from a TP=2xDP=2
 run without parsing mesh_shape, and two TP runs sharded under different
 rule tables never read as the same configuration. Null for writers with
-no mesh (serving headers), but the KEY must exist — absence is drift.
+no mesh (serving headers), but the KEY must exist — absence is drift;
+v9 added the causal tracing plane (tpuddp/observability/trace.py): the
+required run_meta ``tracing`` provenance field (null = tracing off — a
+reader must distinguish "no spans because tracing was off" from
+"predates the tracing plane"), the ``trace_summary`` record type (span
+and drop accounting plus the slowest-span table, written once at drain
+by every traced writer), and the ``trace_<role>.json`` sidecar artifact
+(a Chrome-trace-event file with a ``tpuddp`` provenance block,
+:func:`validate_trace_payload` — loadable in Perfetto as-is).
 Readers accept every version up to their own ``SCHEMA_VERSION`` and
 reject newer files; the per-version required-field sets apply at the
 version each record CARRIES, so a v2 history (no occupancy fields) stays
@@ -70,11 +78,11 @@ import hashlib
 import json
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 RECORD_TYPES = (
     "run_meta", "epoch", "step_stats", "event", "serving_stats",
-    "decode_stats",
+    "decode_stats", "trace_summary",
 )
 
 # Required keys per record type (beyond the envelope's type/schema_version).
@@ -149,6 +157,19 @@ _REQUIRED = {
         "kv_occupancy",
         "active_sequences",
     ),
+    # the tracing plane's drain digest (schema v9, observability/trace.py):
+    # one row per traced writer — completed-span count, ring drops (the
+    # honesty field: a reader knows whether the artifact is the WHOLE run
+    # or the newest window of it), still-open spans at drain, per-kind
+    # counts, and the slowest-span table.
+    "trace_summary": (
+        "role",
+        "spans",
+        "dropped",
+        "open_spans",
+        "by_kind",
+        "slowest",
+    ),
 }
 
 # Fields additionally required of records stamped at schema_version >= N:
@@ -201,6 +222,14 @@ _REQUIRED_SINCE = {
     8: {
         "run_meta": ("mesh",),
     },
+    # v9: the causal tracing plane (observability/trace.py). Null for every
+    # untraced writer (the default — tracing is opt-in) but the KEY must
+    # exist: a reader needs to distinguish "no trace artifact because
+    # tracing was off" from "this header predates the tracing plane"; an
+    # armed block names the ring capacity and the artifact file.
+    9: {
+        "run_meta": ("tracing",),
+    },
 }
 
 def stamp(record_type: str, record: dict) -> dict:
@@ -234,6 +263,7 @@ def make_run_meta(
     decode: Optional[dict] = None,
     survivability: Optional[dict] = None,
     tp_rules_hash: Optional[str] = None,
+    tracing: Optional[dict] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Build the run_meta header row from live run objects.
@@ -305,6 +335,9 @@ def make_run_meta(
         # (request TTL, probation bounds, retry budget; null = not a
         # serving writer — training runs have no shedding/failover story)
         "survivability": survivability,
+        # required since schema v9: the causal tracing plane's provenance
+        # (ring capacity + artifact name; null = tracing off, the default)
+        "tracing": tracing,
     }
     if extra:
         record.update(extra)
@@ -512,6 +545,121 @@ def validate_flight_payload(payload) -> List[str]:
         for e in validate_record(run_meta, 0):
             errors.append(f"run_meta: {e}")
     return errors
+
+
+# Trace artifact (trace_<role>.json) — the causal tracing plane's
+# Chrome-trace-event sidecar (tpuddp/observability/trace.py), loadable in
+# Perfetto as-is. ONE JSON object: ``traceEvents`` (complete "X" span
+# events + metadata/flow events) plus a ``tpuddp`` provenance block.
+TRACE_TYPE = "trace"
+_TRACE_META_REQUIRED = (
+    "role",
+    "process_index",
+    "capacity",
+    "spans",
+    "dropped",
+    "open_spans",
+    "by_kind",
+    "slowest",
+    "clock_sync",
+)
+
+
+def validate_trace_payload(payload) -> List[str]:
+    """Schema errors for a trace-artifact payload (empty = valid).
+
+    Nesting is part of the contract: every X event's ``parent_id`` must
+    resolve to a span present in the artifact — but only when the ring
+    dropped nothing (``tpuddp.dropped == 0``); once the ring has evicted
+    old spans, orphaned children of evicted parents are expected, not
+    drift."""
+    if not isinstance(payload, dict):
+        return ["trace payload is not a JSON object"]
+    errors = []
+    events = payload.get("traceEvents")
+    if not isinstance(events, list):
+        errors.append("'traceEvents' must be a list")
+        events = []
+    meta = payload.get("tpuddp")
+    if not isinstance(meta, dict):
+        return errors + ["missing 'tpuddp' provenance block"]
+    if meta.get("type") != TRACE_TYPE:
+        errors.append(
+            f"tpuddp.type must be {TRACE_TYPE!r}, got {meta.get('type')!r}"
+        )
+    version = meta.get("schema_version")
+    if not isinstance(version, int) or version < 9:
+        errors.append(
+            f"tpuddp.schema_version {version!r} is not an int >= 9 (trace "
+            "artifacts were introduced at v9)"
+        )
+    elif version > SCHEMA_VERSION:
+        errors.append(
+            f"tpuddp.schema_version {version} is newer than this reader's "
+            f"{SCHEMA_VERSION}"
+        )
+    errors += [
+        f"tpuddp block missing field {k!r}"
+        for k in _TRACE_META_REQUIRED
+        if k not in meta
+    ]
+    clock = meta.get("clock_sync")
+    if isinstance(clock, dict):
+        for k in ("unix_us", "perf_ns"):
+            if not isinstance(clock.get(k), (int, float)):
+                errors.append(f"clock_sync.{k} is not a number")
+    span_ids = set()
+    x_events = []
+    for i, e in enumerate(events):
+        if not isinstance(e, dict) or "ph" not in e:
+            errors.append(f"event {i}: not an object with a 'ph' field")
+            continue
+        if e["ph"] != "X":
+            continue
+        x_events.append((i, e))
+        missing = [k for k in ("name", "ts", "dur", "pid", "tid") if k not in e]
+        if missing:
+            errors.append(f"event {i} (X): missing field(s) {missing}")
+        args = e.get("args")
+        if not isinstance(args, dict) or "span_id" not in args or (
+            "trace_id" not in args
+        ):
+            errors.append(
+                f"event {i} (X): args must carry span_id and trace_id"
+            )
+            continue
+        span_ids.add(args["span_id"])
+    if meta.get("dropped") == 0:
+        for i, e in x_events:
+            parent = (e.get("args") or {}).get("parent_id")
+            if parent is not None and parent not in span_ids:
+                errors.append(
+                    f"event {i} (X): orphan parent_id {parent} — no such "
+                    "span in the artifact (and the ring dropped nothing)"
+                )
+    return errors
+
+
+def validate_trace_file(path: str) -> Tuple[List[str], int]:
+    """Parse + validate a ``trace_<role>.json`` artifact. Returns
+    ``(errors, n_span_events)``; non-strict JSON is itself an error."""
+
+    def _reject(token):
+        raise ValueError(f"non-strict JSON token {token}")
+
+    try:
+        with open(path) as f:
+            payload = json.load(f, parse_constant=_reject)
+    except (OSError, ValueError) as e:
+        return [f"cannot parse {path}: {e}"], 0
+    errors = validate_trace_payload(payload)
+    n = 0
+    if isinstance(payload, dict) and isinstance(payload.get("traceEvents"), list):
+        n = sum(
+            1 for e in payload["traceEvents"]
+            if isinstance(e, dict) and e.get("ph") == "X"
+        )
+    return errors, n
 
 
 def validate_flight_file(path: str) -> Tuple[List[str], int]:

@@ -58,6 +58,7 @@ import numpy as np
 from tpuddp.models import load_model
 from tpuddp.models.transformer import TransformerLM, prefill_buckets
 from tpuddp.observability import MetricsWriter, schema
+from tpuddp.observability import trace as trace_lib
 from tpuddp.resilience import faults
 from tpuddp.serving import queue as queue_mod
 from tpuddp.serving import survive as survive_lib
@@ -129,7 +130,7 @@ class DecodeRequest:
     __slots__ = (
         "id", "tenant", "tokens", "max_new_tokens", "temperature", "seed",
         "stop_token", "rows", "key", "t_enqueue", "result",
-        "deadline", "resume_tokens", "failed_from", "failovers",
+        "deadline", "resume_tokens", "failed_from", "failovers", "trace",
     )
 
     def __init__(
@@ -155,6 +156,11 @@ class DecodeRequest:
         # incident; bounded by SurvivePolicy.max_failovers (the
         # poisoned-request firewall)
         self.failovers = 0
+        # causal-tracing context (observability/trace.py; None = off):
+        # {"root": Span, "open": Span|None, "last_id": int|None} — the one
+        # tree this session keeps across queueing, prefill, AND failover,
+        # so a resumed stream is a single trace with a follows_from edge
+        self.trace = None
 
     @property
     def total_tokens(self) -> int:
@@ -389,11 +395,24 @@ class DecodeEngine:
         self._health_lock = threading.Lock()
         self._step_counter = itertools.count(1)  # chaos site step=N
         self._obs_cfg = cfg_lib.resolve_observability(observability)
+        # causal tracing plane (observability/trace.py, default OFF): one
+        # tree per session (request -> admission -> queue_wait -> prefill,
+        # failover episodes linked follows_from so a resumed stream stays
+        # ONE trace) plus per-replica decode_step rows; trace_decode.json
+        # at drain, live on /trace
+        self.tracer = trace_lib.tracer_from_config(
+            self._obs_cfg, "decode", run_dir=out_dir
+        )
+        self._engine_trace = None  # the decode_step timeline's trace id
         self.flight = None
         if self._obs_cfg["flight_recorder"] and out_dir:
             self.flight = flight_lib.install(flight_lib.FlightRecorder(
                 out_dir, capacity=int(self._obs_cfg["flight_capacity"]),
             ))
+            if self.tracer.enabled:
+                self.flight.add_context(
+                    "open_spans", self.tracer.open_span_summaries
+                )
         self.writer = (
             MetricsWriter(out_dir, flight=self.flight) if out_dir else None
         )
@@ -430,6 +449,7 @@ class DecodeEngine:
         """Queue shed callback: one queued decode request expired past its
         deadline and was dropped before prefill (its future already carries
         the typed ``deadline_exceeded`` rejection)."""
+        self._trace_fail(request, "deadline_exceeded")
         self.stats.record_shed(request.tenant)
 
     def kv_occupancy(self) -> float:
@@ -472,6 +492,9 @@ class DecodeEngine:
             self.exporter.register_source(
                 "decode", self.stats.export_source(engine=self)
             )
+            if self.tracer.enabled:
+                self.exporter.set_trace_source(self.tracer.endpoint_payload)
+        self._engine_trace = self.tracer.new_trace()
         if self.writer is not None:
             self.writer.write(schema.make_run_meta(
                 world_size=len(self.replicas),
@@ -490,6 +513,7 @@ class DecodeEngine:
                 },
                 decode=self.decode_meta(),
                 survivability=self.survive.meta(),
+                tracing=self.tracer.describe(),
                 extra={
                     "api": "serving_decode",
                     "model": self.cfg.get("model"),
@@ -548,6 +572,12 @@ class DecodeEngine:
         if not self._drained:
             self._drained = True
             self.stats.flush_window()
+            if self.tracer.enabled:
+                if self.writer is not None:
+                    self.writer.write(schema.stamp(
+                        "trace_summary", self.tracer.summary_record()
+                    ))
+                self.tracer.export()
             if self.writer is not None:
                 summary = self.stats.summary()
                 self.writer.write(schema.stamp("event", {
@@ -593,6 +623,13 @@ class DecodeEngine:
         NEVER killed by its deadline."""
         tokens = np.asarray(tokens)
         self.stats.record_submit()
+        t = self.tracer
+        root = t.start_span(
+            "request", trace_lib.KIND_REQUEST, tid="client",
+            attrs={"tenant": str(tenant)},
+        )
+        adm = t.start_span("admission", trace_lib.KIND_ADMISSION, parent=root)
+        request = None
         try:
             if tokens.ndim != 1 or tokens.shape[0] < 1:
                 raise AdmissionError(
@@ -639,8 +676,28 @@ class DecodeEngine:
                     time.perf_counter(), self.survive.request_ttl_s, deadline_s
                 ),
             )
+            t.end_span(
+                adm, prompt_len=int(tokens.shape[0]), request=request.id
+            )
+            if t.enabled:
+                # attach BEFORE put (the request-engine rule): once put()
+                # publishes the request a decode loop may place it, and a
+                # trace attached after would race the prefill and leak a
+                # never-closed queue_wait
+                request.trace = {
+                    "root": root,
+                    "open": t.start_span(
+                        "queue_wait", trace_lib.KIND_QUEUE_WAIT, parent=root,
+                    ),
+                    "last_id": None,
+                }
             self.queue.put(request)
         except AdmissionError as e:
+            if request is not None and request.trace:
+                t.end_span(request.trace["open"], error=e.reason)
+                request.trace = None
+            t.end_span(adm, rejected=e.reason)
+            t.end_span(root, error=e.reason)
             self.stats.record_reject(tenant, e.reason)
             raise
         return request.result
@@ -651,7 +708,19 @@ class DecodeEngine:
         the very next admission pass) and deliver the final array."""
         cache.free(seq.slot)
         seq.req.result._deliver(np.asarray(seq.out, np.int32))
+        if seq.req.trace:
+            self.tracer.end_span(
+                seq.req.trace["root"], tokens=len(seq.out),
+                failovers=seq.req.failovers,
+            )
+            seq.req.trace = None
         self.stats.record_finish(seq.req.tenant)
+
+    def _trace_fail(self, req: DecodeRequest, error) -> None:
+        """Close a failed session's trace (the shared
+        :func:`~tpuddp.observability.trace.end_request_trace` sequence —
+        every failure path: shed, max-failovers, mortuary)."""
+        trace_lib.end_request_trace(self.tracer, req, error)
 
     def _prefill_dispatch(
         self, replica: DecodeReplica, slot: int, req: DecodeRequest
@@ -664,13 +733,43 @@ class DecodeEngine:
         cache = replica.cache
         n = len(req.tokens)
         P = batching.bucket_for(n, self.max_prompt_len)
+        t = self.tracer
+        if req.trace and req.trace.get("open") is not None:
+            t.end_span(req.trace["open"])  # queue wait ends at placement
+            req.trace["open"] = None
+        resuming = req.failed_from is not None
+        psp = t.start_span(
+            "prefill", trace_lib.KIND_PREFILL,
+            parent=req.trace["root"] if req.trace else None,
+            # the failover edge: a resume's prefill follows causally from
+            # the session's last span on the dead replica — one trace, one
+            # stream, across the migration
+            follows_from=(
+                req.trace.get("last_id") if (req.trace and resuming) else None
+            ),
+            attrs={
+                "replica": replica.index, "prompt_len": n, "bucket": P,
+                **({"resume": True} if resuming else {}),
+            },
+        )
         buf = np.zeros((1, P), np.int32)
         buf[0, :n] = req.tokens
-        logits, replica.kpool, replica.vpool = replica._prefill(
-            replica.params, replica.kpool, replica.vpool,
-            jnp.asarray(cache.tables[slot]), jnp.asarray(buf),
-            jnp.asarray(n, jnp.int32),
-        )
+        try:
+            logits, replica.kpool, replica.vpool = replica._prefill(
+                replica.params, replica.kpool, replica.vpool,
+                jnp.asarray(cache.tables[slot]), jnp.asarray(buf),
+                jnp.asarray(n, jnp.int32),
+            )
+        except BaseException as e:
+            t.end_span(psp, error=repr(e))
+            if req.trace:
+                # the errored prefill IS the session's last span: a later
+                # resume must follows_from it or the trace loses the episode
+                req.trace["last_id"] = psp.span_id
+            raise
+        t.end_span(psp)
+        if req.trace:
+            req.trace["last_id"] = psp.span_id
         cache.lengths[slot] = n
         return logits
 
@@ -744,6 +843,17 @@ class DecodeEngine:
     def _record_failover(
         self, replica: DecodeReplica, req: DecodeRequest, tokens: int
     ) -> None:
+        if req.trace:
+            # the episode marker (zero-length annotation in the session's
+            # own trace — the resume prefill carries the follows_from edge)
+            self.tracer.end_span(self.tracer.start_span(
+                "failover", trace_lib.KIND_FAILOVER, parent=req.trace["root"],
+                attrs={
+                    "from_replica": req.failed_from,
+                    "to_replica": replica.index,
+                    "tokens_journaled": tokens,
+                },
+            ))
         self.stats.record_failover(req.tenant)
         self._event({
             "event": "session_failover",
@@ -802,14 +912,38 @@ class DecodeEngine:
         # requeue is appendleft: push pending in reverse to preserve FIFO,
         # then the journals, so live sessions land ahead of untouched work
         for req in reversed(pending):
-            if req is culprit and not self._park(req, error):
-                continue
+            if req is culprit:
+                if not self._park(req, error):
+                    continue
+                # the parked culprit resumes like any other session: name
+                # the replica it died on (the failover event's from_replica,
+                # and what marks its next prefill a resume) and reopen a
+                # queue_wait in its trace — its original one closed when the
+                # failed prefill began, and without this the second wait
+                # renders as an unexplained gap with no follows_from edge
+                req.failed_from = replica.index
+                if req.trace and req.trace.get("open") is None:
+                    req.trace["open"] = self.tracer.start_span(
+                        "queue_wait", trace_lib.KIND_QUEUE_WAIT,
+                        parent=req.trace["root"],
+                        follows_from=req.trace.get("last_id"),
+                        attrs={"parked_from": replica.index},
+                    )
             self.queue.requeue(req)
         pending.clear()
         for slot in sorted(active.keys(), reverse=True):
             seq = active[slot]
             seq.req.resume_tokens = list(seq.out)
             seq.req.failed_from = replica.index
+            if seq.req.trace:
+                # parked: back to waiting — a fresh queue_wait in the SAME
+                # trace, linked to the session's last pre-death span
+                seq.req.trace["open"] = self.tracer.start_span(
+                    "queue_wait", trace_lib.KIND_QUEUE_WAIT,
+                    parent=seq.req.trace["root"],
+                    follows_from=seq.req.trace.get("last_id"),
+                    attrs={"parked_from": replica.index},
+                )
             self.queue.requeue(seq.req)
         active.clear()
         self._active_counts[replica.index] = 0
@@ -818,6 +952,11 @@ class DecodeEngine:
             replica.rebuild()
             replica.canary(self.buckets)
 
+        psp = self.tracer.start_span(
+            f"probation replica {replica.index}", trace_lib.KIND_PROBATION,
+            trace_id=self._engine_trace, tid=f"replica{replica.index}",
+            attrs={"recoveries": replica.recoveries},
+        )
         ok, event = survive_lib.probation_episode(
             replica,
             name=f"decode replica {replica.index}",
@@ -826,6 +965,7 @@ class DecodeEngine:
             count_recovery=culprit is None,
             lock=self._health_lock,
         )
+        self.tracer.end_span(psp, outcome="recovered" if ok else "removed")
         self._event(event)
         return ok
 
@@ -845,6 +985,7 @@ class DecodeEngine:
             "(poisoned-request firewall)",
             req.id, req.tenant, self.survive.max_failovers,
         )
+        self._trace_fail(req, error)
         req.result._deliver(None, error=error)
         return False
 
@@ -940,25 +1081,35 @@ class DecodeEngine:
         tokens = np.zeros((S,), np.int32)
         for slot, seq in active.items():
             tokens[slot] = seq.last_token
-        kind = faults.maybe_serving_fault(
-            "step", step=next(self._step_counter)
+        ssp = self.tracer.start_span(
+            "decode_step", trace_lib.KIND_DECODE_STEP,
+            trace_id=self._engine_trace, tid=f"replica{replica.index}",
+            attrs={"step": replica.steps, "active": len(active)},
         )
-        if kind == "replica_kill":
-            replica.broken = True  # persistent until rebuild()
-        if kind == "pool_poison":
-            # the donated-buffer death: the pools are gone mid-sweep
-            replica.kpool.delete()
-            replica.vpool.delete()
-            raise RuntimeError("injected pool_poison fault: KV pools lost")
-        if kind == "dispatch_wedge":
-            raise RuntimeError("injected dispatch_wedge fault (transient)")
-        replica.check_broken()
-        logits, replica.kpool, replica.vpool = replica._step(
-            replica.params, replica.kpool, replica.vpool,
-            jnp.asarray(cache.tables), jnp.asarray(cache.lengths),
-            jnp.asarray(tokens),
-        )
-        logits = np.asarray(logits)  # fetch = fence
+        try:
+            kind = faults.maybe_serving_fault(
+                "step", step=next(self._step_counter)
+            )
+            if kind == "replica_kill":
+                replica.broken = True  # persistent until rebuild()
+            if kind == "pool_poison":
+                # the donated-buffer death: the pools are gone mid-sweep
+                replica.kpool.delete()
+                replica.vpool.delete()
+                raise RuntimeError("injected pool_poison fault: KV pools lost")
+            if kind == "dispatch_wedge":
+                raise RuntimeError("injected dispatch_wedge fault (transient)")
+            replica.check_broken()
+            logits, replica.kpool, replica.vpool = replica._step(
+                replica.params, replica.kpool, replica.vpool,
+                jnp.asarray(cache.tables), jnp.asarray(cache.lengths),
+                jnp.asarray(tokens),
+            )
+            logits = np.asarray(logits)  # fetch = fence
+        except BaseException as e:
+            self.tracer.end_span(ssp, error=repr(e))
+            raise
+        self.tracer.end_span(ssp)
         replica.steps += 1
         now = time.perf_counter()
         for slot, seq in list(active.items()):
@@ -1030,6 +1181,7 @@ class DecodeEngine:
                     "all decode replicas removed after failed recovery"
                 )
                 for req in group:
+                    self._trace_fail(req, err)
                     req.result._deliver(None, error=err)
                 continue
             try:

@@ -27,6 +27,7 @@ from typing import List, Optional
 import numpy as np
 
 from tpuddp.observability import MetricsWriter, schema
+from tpuddp.observability import trace as trace_lib
 from tpuddp.resilience import faults
 from tpuddp.serving import queue as queue_mod
 from tpuddp.serving import survive as survive_lib
@@ -93,11 +94,22 @@ class ServingEngine:
         self._health_lock = threading.Lock()
         self._batch_counter = itertools.count(1)  # chaos site batch=N
         self._obs_cfg = cfg_lib.resolve_observability(observability)
+        # causal tracing plane (observability/trace.py, default OFF): one
+        # span tree per request — request -> admission -> queue_wait ->
+        # serve — plus per-replica infer/probation rows, exported as
+        # trace_serving.json at drain and served live on /trace
+        self.tracer = trace_lib.tracer_from_config(
+            self._obs_cfg, "serving", run_dir=out_dir
+        )
         self.flight = None
         if self._obs_cfg["flight_recorder"] and out_dir:
             self.flight = flight_lib.install(flight_lib.FlightRecorder(
                 out_dir, capacity=int(self._obs_cfg["flight_capacity"]),
             ))
+            if self.tracer.enabled:
+                self.flight.add_context(
+                    "open_spans", self.tracer.open_span_summaries
+                )
         self.writer = (
             MetricsWriter(out_dir, flight=self.flight) if out_dir else None
         )
@@ -144,6 +156,8 @@ class ServingEngine:
             self.exporter.register_source(
                 "serving", self.stats.export_source(engine=self)
             )
+            if self.tracer.enabled:
+                self.exporter.set_trace_source(self.tracer.endpoint_payload)
         if self.writer is not None:
             cfg = self._config
             self.writer.write(
@@ -165,6 +179,7 @@ class ServingEngine:
                         ),
                     },
                     survivability=self.survive.meta(),
+                    tracing=self.tracer.describe(),
                     extra={
                         "api": "serving",
                         "model": cfg.get("model"),
@@ -225,6 +240,12 @@ class ServingEngine:
         if not self._drained:
             self._drained = True
             self.stats.flush_window()
+            if self.tracer.enabled:
+                if self.writer is not None:
+                    self.writer.write(schema.stamp(
+                        "trace_summary", self.tracer.summary_record()
+                    ))
+                self.tracer.export()
             if self.writer is not None:
                 self.writer.write(
                     schema.stamp(
@@ -253,6 +274,11 @@ class ServingEngine:
         return self.stats.summary()
 
     # --------------------------------------------------------------- client --
+    def _trace_close(self, request, error) -> None:
+        """Close a failed/shed request's trace (the shared
+        :func:`~tpuddp.observability.trace.end_request_trace` sequence)."""
+        trace_lib.end_request_trace(self.tracer, request, error)
+
     def _on_shed(self, request) -> None:
         """Queue shed callback: one queued request expired past its deadline
         and was dropped before dispatch (its future already carries the
@@ -262,6 +288,7 @@ class ServingEngine:
         if getattr(request, "retries", 0):
             self.retry_budget.refund(request.tenant, request.retries)
             request.retries = 0
+        self._trace_close(request, "deadline_exceeded")
         self.stats.record_shed(request.tenant)
 
     def submit(
@@ -278,6 +305,13 @@ class ServingEngine:
         work already dispatched always completes."""
         x = np.asarray(x)
         self.stats.record_submit()
+        t = self.tracer
+        root = t.start_span(
+            "request", trace_lib.KIND_REQUEST, tid="client",
+            attrs={"tenant": str(tenant)},
+        )
+        adm = t.start_span("admission", trace_lib.KIND_ADMISSION, parent=root)
+        request = None
         try:
             if x.ndim != 1 + len(self.pool.sample_shape) or (
                 tuple(x.shape[1:]) != self.pool.sample_shape
@@ -311,8 +345,26 @@ class ServingEngine:
                     time.perf_counter(), self.survive.request_ttl_s, deadline_s
                 ),
             )
+            t.end_span(adm, rows=int(x.shape[0]), request=request.id)
+            if t.enabled:
+                # attach BEFORE put: the instant put() publishes the request
+                # a dispatcher may take and serve it — a trace attached
+                # after would race the dispatch and leak a never-closed
+                # queue_wait. The trace then rides the queue on the request
+                # itself, so retries and failovers extend the SAME tree.
+                request.trace = {
+                    "root": root,
+                    "open": t.start_span(
+                        "queue_wait", trace_lib.KIND_QUEUE_WAIT, parent=root,
+                    ),
+                }
             self.queue.put(request)
         except AdmissionError as e:
+            if request is not None and request.trace:
+                t.end_span(request.trace["open"], error=e.reason)
+                request.trace = None
+            t.end_span(adm, rejected=e.reason)
+            t.end_span(root, error=e.reason)
             self.stats.record_reject(tenant, e.reason)
             raise
         return request.result
@@ -360,6 +412,24 @@ class ServingEngine:
                     self._fail_request(r, err)
                 continue
             t_dispatch = time.perf_counter()
+            t = self.tracer
+            for r in batch.requests:
+                if r.trace:
+                    # queue wait is over; the serve interval (dispatch ->
+                    # delivery, shared by the whole coalesced batch) begins
+                    t.end_span(r.trace["open"])
+                    r.trace["open"] = t.start_span(
+                        "serve", trace_lib.KIND_SERVE, parent=r.trace["root"],
+                        attrs={"replica": replica.index},
+                    )
+            bsp = t.start_span(
+                "infer", trace_lib.KIND_DISPATCH,
+                tid=f"replica{replica.index}",
+                attrs={
+                    "requests": len(batch.requests),
+                    "rows": int(batch.x.shape[0]),
+                },
+            )
             try:
                 kind = faults.maybe_serving_fault(
                     "batch", batch=next(self._batch_counter)
@@ -374,6 +444,7 @@ class ServingEngine:
             except (KeyboardInterrupt, SystemExit):
                 raise
             except BaseException as e:  # noqa: BLE001 — retried or delivered
+                t.end_span(bsp, error=repr(e))
                 self._dispatch_failed(replica, batch, e)
                 if replica.state == "recovering" and not self._probation(replica):
                     with self._health_lock:
@@ -396,6 +467,7 @@ class ServingEngine:
                         # dispatch-error/unhealthy events are in the ring
                         self.flight.dump("serving_dispatch")
                 continue
+            t.end_span(bsp)
             replica.consecutive_errors = 0
             for r in batch.requests:
                 if r.retries:
@@ -408,6 +480,10 @@ class ServingEngine:
                 # copy, don't view: a view would pin the whole padded
                 # bucket's logits per result and alias clients to each other
                 r.result._deliver(logits[lo:hi].copy())
+                if r.trace:
+                    t.end_span(r.trace["open"])
+                    t.end_span(r.trace["root"], rows=hi - lo)
+                    r.trace = None
             self.stats.record_batch(batch, t_dispatch, t_done)
 
     def _fail_request(self, r, error: BaseException) -> None:
@@ -418,6 +494,7 @@ class ServingEngine:
         if r.retries:
             self.retry_budget.refund(r.tenant, r.retries)
             r.retries = 0
+        self._trace_close(r, error)
         r.result._deliver(None, error=error)
 
     def _dispatch_failed(self, replica: Replica, batch, e: BaseException) -> None:
@@ -435,6 +512,18 @@ class ServingEngine:
                 r.retries += 1
                 retried += 1
                 self.stats.record_retry(r.tenant)
+                if r.trace:
+                    # the failed serve attempt closes; a fresh queue_wait
+                    # follows from it — the retry stays one trace
+                    failed = r.trace["open"]
+                    self.tracer.end_span(
+                        failed, error="dispatch_failed", retry=r.retries,
+                    )
+                    r.trace["open"] = self.tracer.start_span(
+                        "queue_wait", trace_lib.KIND_QUEUE_WAIT,
+                        parent=r.trace["root"],
+                        follows_from=failed.span_id,
+                    )
                 self.queue.requeue(r)
             else:
                 self._fail_request(r, e)
@@ -473,6 +562,11 @@ class ServingEngine:
             replica.warmup(self.scheduler.buckets, self.pool.sample_shape)
             replica.canary(self.pool.sample_shape)
 
+        psp = self.tracer.start_span(
+            f"probation replica {replica.index}", trace_lib.KIND_PROBATION,
+            tid=f"replica{replica.index}",
+            attrs={"recoveries": replica.recoveries},
+        )
         ok, event = survive_lib.probation_episode(
             replica,
             name=f"serving replica {replica.index}",
@@ -480,6 +574,7 @@ class ServingEngine:
             policy=self.survive,
             lock=self._health_lock,
         )
+        self.tracer.end_span(psp, outcome="recovered" if ok else "removed")
         if ok:
             replica.consecutive_errors = 0
         self._event(event)

@@ -108,7 +108,7 @@ class Request:
 
     __slots__ = (
         "id", "tenant", "x", "rows", "key", "t_enqueue", "result",
-        "deadline", "retries", "resume_tokens",
+        "deadline", "retries", "resume_tokens", "trace",
     )
 
     def __init__(self, tenant: str, x: np.ndarray, deadline: Optional[float] = None):
@@ -124,6 +124,11 @@ class Request:
         # non-None marks a failover journal (a live session mid-migration,
         # decode engine); journals are in-flight work and are never shed
         self.resume_tokens = None
+        # causal-tracing context (observability/trace.py; None = tracing
+        # off): the engine hangs the request's root span + the currently
+        # open child here so the request's whole life — admission, queue
+        # wait, dispatch, retries, failover — stays ONE trace
+        self.trace = None
 
 
 class RequestQueue:

@@ -114,6 +114,7 @@ def _fused_pass(
     ddp, state, loader, scan_k: int, step_one, step_many, probe_cb=None,
     accum: int = 1, poll=preemption_requested, inject_cb=None, tel=None,
     pipeline: Optional[pipeline_lib.PipelineConfig] = None,
+    tracer=None, trace_parent=None, comm_attrs=None,
 ):
     """One pass over ``loader`` — the async pipelined runner
     (:mod:`tpuddp.training.pipeline`): K-fused dispatch, a ``depth``-chunk
@@ -126,7 +127,8 @@ def _fused_pass(
         ddp, state, loader, scan_k, step_one, step_many,
         cfg=pipeline if pipeline is not None else pipeline_lib.DEFAULT,
         probe_cb=probe_cb, accum=accum, poll=poll, inject_cb=inject_cb,
-        tel=tel,
+        tel=tel, tracer=tracer, trace_parent=trace_parent,
+        comm_attrs=comm_attrs,
     )
 
 
@@ -205,6 +207,7 @@ def run_training_loop(
     from tpuddp.observability import aggregate as agg_lib
     from tpuddp.observability import exporter as exp_lib
     from tpuddp.observability import flight as flight_lib
+    from tpuddp.observability import trace as trace_lib
     from tpuddp.resilience import watchdog as wd_lib
 
     is_main = jax.process_index() == 0
@@ -268,11 +271,19 @@ def run_training_loop(
     # the flight ring tees every history record (every process keeps one);
     # the exporter/aggregator start below once the telemetry bundle exists.
     obs_cfg = cfg_lib.resolve_observability(observability)
+    # causal tracing plane (observability/trace.py, default OFF): epoch ->
+    # stage/dispatch/collective/readback span trees, exported as
+    # trace_train.json at drain and served on /trace. Host bracketing only.
+    tracer = trace_lib.tracer_from_config(obs_cfg, "train", run_dir=save_dir)
     flight = None
     if obs_cfg["flight_recorder"] and save_dir is not None:
         flight = flight_lib.install(flight_lib.FlightRecorder(
             save_dir, capacity=int(obs_cfg["flight_capacity"]),
         ))
+        if tracer.enabled:
+            # a crash dump embeds the still-open spans: the exact stage the
+            # process died in, not just the last flushed window
+            flight.add_context("open_spans", tracer.open_span_summaries)
     metrics_writer = MetricsWriter(save_dir, flight=flight)
     # gradient-comm wire-bytes accounting (parallel/comm.py counter): one
     # optimizer update per accumulation cycle; the payload per update is
@@ -328,6 +339,8 @@ def run_training_loop(
     exporter = exp_lib.exporter_from_config(obs_cfg, run_dir=save_dir)
     if exporter is not None:
         exporter.start()
+        if tracer.enabled:
+            exporter.set_trace_source(tracer.endpoint_payload)
     obs_meta = {
         "exporter": exporter.describe() if exporter is not None else False,
         "aggregate": bool(obs_cfg["aggregate"]),
@@ -347,6 +360,8 @@ def run_training_loop(
         # v8 mesh block: names the TP rule table when the mesh carries a
         # real model axis (None on pure-DP wraps)
         tp_rules_hash=getattr(ddp, "tp_rules_hash", None),
+        # v9 tracing block: ring capacity + artifact name (null = off)
+        tracing=tracer.describe(),
         extra=meta_extra,
     ))
     for ev in reshard_log:
@@ -521,6 +536,28 @@ def run_training_loop(
             f"Training on {len(train_loader)} batches, test on {len(test_loader)} batches"
         )
 
+    # the whole run is ONE trace: every epoch span (and its stage/dispatch/
+    # collective/readback children) shares this id. The comm annotation only
+    # arms on the train pass of a hooked run — eval dispatches carry no
+    # gradient exchange.
+    run_trace_id = tracer.new_trace()
+    epoch_span = None
+    comm_attrs = None
+    if tracer.enabled and getattr(ddp, "comm_hook", "none") != "none":
+        comm_attrs = {
+            "hook": ddp.comm_hook,
+            "topology": getattr(ddp, "comm_topology", "flat"),
+            "wire_bytes_per_update": getattr(
+                ddp, "grad_comm_bytes_per_step", None
+            ),
+            "wire_bytes_per_update_f32": getattr(
+                ddp, "grad_comm_bytes_per_step_f32", None
+            ),
+            "inter_host_bytes_per_update": getattr(
+                ddp, "grad_comm_bytes_inter_host", None
+            ),
+        }
+
     try:
         epoch = start_epoch
         while epoch < num_epochs:
@@ -558,6 +595,11 @@ def run_training_loop(
                     )
             t0 = time.perf_counter()
             tel.start_epoch(epoch)
+            epoch_span = tracer.start_span(
+                f"epoch {epoch}", trace_lib.KIND_EPOCH,
+                trace_id=run_trace_id, tid="train",
+                attrs={"epoch": epoch},
+            )
             if is_main:
                 log(f"Process {jax.process_index()}, Epoch {epoch}")
             if set_epoch:
@@ -583,7 +625,8 @@ def run_training_loop(
                 ddp, state, train_loader, scan_steps,
                 ddp.train_step, ddp.train_step_many, probe_cb=train_probe,
                 accum=accum, poll=poll, inject_cb=nan_inject, tel=tel,
-                pipeline=pipeline,
+                pipeline=pipeline, tracer=tracer, trace_parent=epoch_span,
+                comm_attrs=comm_attrs,
             )
             if interrupted:
                 emergency_stop(epoch)
@@ -596,6 +639,7 @@ def run_training_loop(
                 lambda s, b: (s, ddp.eval_step(s, b)),
                 lambda s, b: (s, ddp.eval_step_many(s, b)),
                 poll=poll, pipeline=pipeline,
+                tracer=tracer, trace_parent=epoch_span,
             )
             if interrupted:
                 emergency_stop(epoch, completed=True)
@@ -739,6 +783,7 @@ def run_training_loop(
                 # may already be suspect — restore last-good instead of
                 # checkpointing a wedged trajectory
                 if can_roll_back():
+                    tracer.end_span(epoch_span, rollback="consecutive_skips")
                     state, epoch = rollback_to_last_good(
                         state, epoch,
                         f"{consec_skips} consecutive non-finite updates skipped",
@@ -765,6 +810,11 @@ def run_training_loop(
                     save_dir, epoch, state, keep_last=keep_last,
                     world_size=getattr(ddp, "world_size", None),
                 )
+            tracer.end_span(
+                epoch_span,
+                train_loss=float(train_loss),
+                skipped_steps=epoch_skips,
+            )
             epoch += 1
     except TrainingPreempted:
         raise  # emergency_stop already dumped the "preempt" recording
@@ -783,6 +833,13 @@ def run_training_loop(
         # down too: endpoint closed, flight ring deregistered.
         tel.finish()
         stop_profiler()
+        if tracer.enabled:
+            # the causal artifact lands on EVERY exit path (clean drain,
+            # preempt, crash): the typed summary goes into the history
+            # stream before it closes, the Chrome trace next to it — spans
+            # still open (an interrupted epoch) export flagged `open`
+            metrics_writer.write(stamp("trace_summary", tracer.summary_record()))
+            tracer.export()
         metrics_writer.close()
         if exporter is not None:
             exporter.stop()

@@ -44,6 +44,7 @@ from dataclasses import asdict, dataclass
 import jax
 
 from tpuddp.observability import telemetry as telemetry_lib
+from tpuddp.observability import trace as trace_lib
 from tpuddp.training.step import accumulate_metrics, stack_batches
 from tpuddp.utils import batching
 
@@ -226,7 +227,8 @@ def _never():
 def run_pass(
     ddp, state, loader, scan_k: int, step_one, step_many, *,
     cfg: PipelineConfig = DEFAULT, probe_cb=None, accum: int = 1,
-    poll=_never, inject_cb=None, tel=None,
+    poll=_never, inject_cb=None, tel=None, tracer=None, trace_parent=None,
+    comm_attrs=None,
 ):
     """One pipelined pass over ``loader``: K-fused dispatch with a
     ``cfg.depth``-chunk staged device queue and a deferred readback drain.
@@ -246,10 +248,23 @@ def run_pass(
     None -> inert) brackets each dispatch and receives the occupancy fields
     (host stall, staged queue depth, in-flight depth).
 
+    Tracing (``tracer``, an :mod:`~tpuddp.observability.trace` Tracer; None
+    -> inert): each staged placement lands a ``stage`` span, each jitted
+    call a ``dispatch`` span (issue-time window — dispatch is async, so the
+    span measures what the HOST paid, matching the recorder's lap
+    semantics), the deferred metric drain a ``readback`` span, and — when
+    ``comm_attrs`` names a live comm hook — a zero-length ``collective``
+    annotation span per dispatch carrying the wire-byte accounting. All
+    children of ``trace_parent`` (the driver's epoch span). Pure host
+    bracketing of calls this pass already makes: no new fences, bitwise
+    identity untouched.
+
     Returns ``(state, accumulated_metrics, interrupted)``.
     """
     if tel is None:
         tel = telemetry_lib.NULL
+    if tracer is None:
+        tracer = trace_lib.NULL
     depth = staging_depth_for(
         cfg.depth,
         (getattr(loader, "batch_nbytes", None) or 0) * max(1, scan_k) or None,
@@ -262,20 +277,56 @@ def run_pass(
         nonlocal state
         chunk, n_steps, n_samples, use_many = staged.popleft()
         tel.pre_dispatch(n_steps)
+        dsp = tracer.start_span(
+            "dispatch", trace_lib.KIND_DISPATCH, parent=trace_parent,
+            attrs={"steps": n_steps, "samples": n_samples},
+        )
         if use_many:
             state, metrics = step_many(state, chunk)
         else:
             state, metrics = step_one(state, chunk)
         if cfg.sync_readback:
             # the serial A/B cadence: results land before the next dispatch
+            rsp = tracer.start_span(
+                "readback", trace_lib.KIND_READBACK, parent=dsp,
+            )
             jax.block_until_ready(metrics)
+            tracer.end_span(rsp, sync=True)
         drain.offer(metrics)
+        if comm_attrs is not None:
+            # the comm hook's bucketed exchange runs INSIDE the compiled
+            # program — the host cannot time it, so this is an annotation
+            # span (zero-length, nested in the dispatch): which hook, how
+            # many wire bytes per optimizer update, how many updates this
+            # dispatch carried
+            tracer.end_span(tracer.start_span(
+                "grad_comm", trace_lib.KIND_COLLECTIVE, parent=dsp,
+                attrs={**comm_attrs, "updates": max(1, n_steps // max(1, accum))},
+            ))
+        tracer.end_span(dsp, inflight=drain.inflight)
         tel.post_dispatch(
             n_steps, n_samples, metrics,
             host_stall_s=stall.take(),
             staging_depth=len(staged),
             inflight_depth=drain.inflight,
         )
+
+    def stage(chunk_value, n_steps, n_samples, use_many):
+        ssp = tracer.start_span(
+            "stage", trace_lib.KIND_STAGE, parent=trace_parent,
+            attrs={"steps": n_steps},
+        )
+        staged.append((chunk_value(), n_steps, n_samples, use_many))
+        tracer.end_span(ssp)
+
+    def drain_all():
+        rsp = tracer.start_span(
+            "readback", trace_lib.KIND_READBACK, parent=trace_parent,
+            attrs={"pending": drain.inflight},
+        )
+        acc = drain.drain()
+        tracer.end_span(rsp)
+        return acc
 
     chunk = []
     for batch_idx, host_batch in enumerate(stalled_iter(loader, stall)):
@@ -285,31 +336,31 @@ def run_pass(
             probe_cb(batch_idx, host_batch)
         tel.offer_batch(host_batch)
         if poll():
-            return state, drain.drain(), True
+            return state, drain_all(), True
         if scan_k <= 1 and accum <= 1:
             # per-batch cadence: the staging queue still overlaps batch N+1's
             # placement with batch N's dispatch (the pre-pipeline path staged
             # nothing ahead here and paid the transfer serially). Same depth
             # semantics as the scan path: `depth` batches held staged ahead.
-            staged.append((ddp.shard(host_batch), 1, len(host_batch[1]), False))
+            stage(lambda: ddp.shard(host_batch), 1, len(host_batch[1]), False)
             while len(staged) > depth or (staged and cfg.sync_readback):
                 dispatch_oldest()
             continue
         chunk.append(host_batch)
         if len(chunk) == scan_k:
-            staged.append((
-                ddp.shard_stacked(stack_batches(chunk)),
+            stage(
+                lambda c=chunk: ddp.shard_stacked(stack_batches(c)),
                 scan_k,
                 sum(len(b[1]) for b in chunk),
                 True,
-            ))
+            )
             chunk = []
             # keep at most `depth` chunks staged ahead; dispatch the oldest
             # beyond that (dispatch is async — the device is already busy)
             while len(staged) > depth or (staged and cfg.sync_readback):
                 dispatch_oldest()
     if poll():
-        return state, drain.drain(), True
+        return state, drain_all(), True
     while staged:
         dispatch_oldest()
     if chunk and accum > 1:
@@ -317,14 +368,15 @@ def run_pass(
         # (a per-batch step would fire a full-scale update per micro-batch)
         tail_samples = sum(len(b[1]) for b in chunk)
         tail = _pad_to_cycles(chunk, accum)
-        staged.append((
-            ddp.shard_stacked(stack_batches(tail)), len(tail), tail_samples, True
-        ))
+        stage(
+            lambda: ddp.shard_stacked(stack_batches(tail)),
+            len(tail), tail_samples, True,
+        )
         dispatch_oldest()
-        return state, drain.drain(), poll()
+        return state, drain_all(), poll()
     for host_batch in chunk:  # remainder: single steps, same semantics
         if poll():
-            return state, drain.drain(), True
-        staged.append((ddp.shard(host_batch), 1, len(host_batch[1]), False))
+            return state, drain_all(), True
+        stage(lambda: ddp.shard(host_batch), 1, len(host_batch[1]), False)
         dispatch_oldest()
-    return state, drain.drain(), poll()
+    return state, drain_all(), poll()
