@@ -17,6 +17,7 @@ Marked ``chaos`` + ``slow``: run with ``tools/run_chaos.py`` or
 
 import json
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -51,6 +52,7 @@ def chaos_env(**extra):
     for k in (
         "TPUDDP_FAULT", "TPUDDP_AUTO_RESUME", "TPUDDP_WATCHDOG_TIMEOUT",
         "TPUDDP_CHAOS_TRAINING", "TPUDDP_DEBUG_NANS", "TPUDDP_WORLD_SIZE",
+        "TPUDDP_MODEL_SIZE", "TPUDDP_CHAOS_PARALLEL",
     ):
         env.pop(k, None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -515,6 +517,203 @@ def test_elastic_mismatched_world_resets_residual(tmp_path):
     assert resets[0]["to_world"] == 3
     assert history_epochs(out) == [0, 1, 2]
     validate_history(out)
+
+
+TP_WORKER = os.path.join(REPO, "tests", "_chaos_tp_worker.py")
+
+
+def test_tp_mesh_failover_both_smaller_shapes_with_loss_parity(tmp_path):
+    """ISSUE 16 headline: a TP=2 x DP=2 token-LM job killed mid-epoch
+    auto-resumes at BOTH feasible 2-chip shapes — TP=2 x DP=1 (data shrink)
+    AND TP=1 x DP=2 (model-width crossing, full reshard) — and each lands
+    the same loss trajectory as the uninterrupted 4-chip run. The reshard
+    episode is named on every surface: typed topology_change rows with
+    model widths, a run_meta resumed_from_model header, an 'elastic
+    reshard' trace span, and (second leg, preempted again post-reshard) a
+    flight-recorder note in the crash dump."""
+    epochs = 3
+    base_dir = tmp_path / "baseline"
+    base = run_train_worker(base_dir, epochs, env=chaos_env(),
+                            worker=TP_WORKER)
+    assert base.returncode == 0, base.stdout[-2000:] + base.stderr[-2000:]
+    base_rows = {
+        r["epoch"]: r for r in history_records(base_dir)
+        if r.get("type") == "epoch"
+    }
+
+    killed = tmp_path / "tp_elastic"
+    first = run_train_worker(
+        killed, epochs,
+        env=chaos_env(TPUDDP_FAULT="preempt@epoch=1",
+                      TPUDDP_CHAOS_OBS='{"tracing": true}'),
+        worker=TP_WORKER,
+    )
+    assert first.returncode == EXIT_PREEMPTED, (
+        first.stdout[-2000:] + first.stderr[-2000:]
+    )
+    emergency = os.path.join(str(killed), "ckpt_1.npz")
+    assert ckpt.read_meta(emergency) == {"epoch": 1, "completed": 0}
+    topo = ckpt.read_topology(emergency)
+    assert topo["world_size"] == 4
+    assert topo["model_size"] == 2
+    assert topo["placement"]  # model-sharded leaves are tagged
+
+    # fork the killed run dir: ONE capacity-loss event, both target shapes
+    shrunk_tp = tmp_path / "tp2dp1"
+    shutil.copytree(str(killed), str(shrunk_tp))
+
+    # --- leg 1: TP=2 x DP=1 (the data axis absorbed the loss) -----------
+    resumed = run_train_worker(
+        shrunk_tp, epochs,
+        env=chaos_env(TPUDDP_AUTO_RESUME=1, TPUDDP_WORLD_SIZE=2,
+                      TPUDDP_MODEL_SIZE=2,
+                      TPUDDP_CHAOS_OBS='{"tracing": true}'),
+        worker=TP_WORKER,
+    )
+    assert resumed.returncode == 0, (
+        resumed.stdout[-2000:] + resumed.stderr[-2000:]
+    )
+    assert "Auto-resume: continuing from epoch 1." in resumed.stdout
+    assert history_epochs(shrunk_tp) == list(range(epochs))
+    events = topology_events(shrunk_tp)
+    assert events and (events[0]["from_world"], events[0]["to_world"]) == (4, 2)
+    assert (events[0]["from_model"], events[0]["to_model"]) == (2, 2)
+    metas = [
+        r for r in history_records(shrunk_tp)
+        if r.get("type") == "run_meta" and r.get("resumed_from_world")
+    ]
+    assert metas and metas[0]["resumed_from_world"] == 4
+    assert metas[0]["resumed_from_model"] == 2
+    assert metas[0]["mesh"] == {
+        "data": 1, "model": 2, "tp_rules_hash": metas[0]["mesh"]["tp_rules_hash"],
+    }
+    validate_history(shrunk_tp)
+    # the reshard episode is a named span in the resumed run's trace
+    with open(os.path.join(str(shrunk_tp), "trace_train.json")) as f:
+        spans = [
+            e for e in json.load(f)["traceEvents"]
+            if isinstance(e, dict) and e.get("ph") == "X"
+        ]
+    reshard_spans = [e for e in spans if e["name"] == "elastic reshard"]
+    assert reshard_spans, [e["name"] for e in spans]
+    assert reshard_spans[0]["args"]["from_world"] == 4
+    assert reshard_spans[0]["args"]["to_world"] == 2
+
+    # --- leg 2: TP=1 x DP=2 (model-width crossing) — preempted AGAIN so
+    # the crash dump proves the flight recorder names the episode ---------
+    second = run_train_worker(
+        killed, epochs,
+        env=chaos_env(TPUDDP_AUTO_RESUME=1, TPUDDP_WORLD_SIZE=2,
+                      TPUDDP_MODEL_SIZE=1,
+                      TPUDDP_FAULT="preempt@epoch=2"),
+        worker=TP_WORKER,
+    )
+    assert second.returncode == EXIT_PREEMPTED, (
+        second.stdout[-2000:] + second.stderr[-2000:]
+    )
+    with open(os.path.join(str(killed), "flightrec_preempt.json")) as f:
+        flight = json.load(f)
+    note = flight["notes"]["elastic_reshard"]
+    assert (note["from_world"], note["to_world"]) == (4, 2)
+    assert (note["from_model"], note["to_model"]) == (2, 1)
+    final = run_train_worker(
+        killed, epochs,
+        env=chaos_env(TPUDDP_AUTO_RESUME=1, TPUDDP_WORLD_SIZE=2,
+                      TPUDDP_MODEL_SIZE=1),
+        worker=TP_WORKER,
+    )
+    assert final.returncode == 0, final.stdout[-2000:] + final.stderr[-2000:]
+    assert history_epochs(killed) == list(range(epochs))
+    events = topology_events(killed)
+    assert (events[0]["from_model"], events[0]["to_model"]) == (2, 1)
+    # the QKV relayout touched params AND their path-congruent moments
+    assert any(
+        leaf.endswith("['attn']['wqkv']") and leaf.startswith(".opt_state")
+        for leaf in events[0]["resharded_leaves"]
+    ), events[0]
+    validate_history(killed)
+
+    # --- loss-trajectory parity vs uninterrupted: pre-kill epochs fed
+    # bitwise-equal state; post-reshard epochs see the SAME global batches
+    # partitioned differently — only f32 reassociation moves (the f32
+    # 'none' hook keeps compression out of the comparison)
+    for out in (shrunk_tp, killed):
+        rows = {
+            r["epoch"]: r for r in history_records(out)
+            if r.get("type") == "epoch"
+        }
+        for e in range(epochs):
+            assert np.isfinite(rows[e]["train_loss"])
+            np.testing.assert_allclose(
+                rows[e]["train_loss"], base_rows[e]["train_loss"],
+                rtol=1e-3, atol=2e-3,
+                err_msg=f"{out}: epoch {e} train-loss parity broken",
+            )
+
+
+def test_fleet_resize_tp_job_rides_drain_contract(tmp_path):
+    """ISSUE 16 fleet leg: a running TP=2 job resized by the controller
+    (displaced by a higher-priority arrival) drains to exit 75 and
+    relaunches at the clamped smaller world with $TPUDDP_MODEL_SIZE pinned
+    — the child reshards onto TP=2 x DP=1 and finishes."""
+    from tpuddp.fleet.controller import FleetController
+    from tpuddp.fleet.spec import JobSpec
+    from tpuddp.resilience.supervisor import SupervisorPolicy
+
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "TPUDDP_BACKEND": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    c = FleetController(
+        4, fleet_dir=str(tmp_path), env=env,
+        supervisor_policy=SupervisorPolicy(backoff_base=0.1, backoff_cap=0.5),
+    )
+    tp = c.submit(JobSpec(
+        name="tp-job", kind="training", priority=0,
+        min_world=2, max_world=4, model_size=2,
+        argv=(sys.executable, "-u", TP_WORKER, "{run_dir}", "6"),
+    ))
+    c.step()
+    assert tp.state == "running" and tp.supervisor.world_size == 4
+    assert tp.supervisor.model_size == 2
+    # let it reach steady training (first checkpoint published) before the
+    # displacement, so the SIGTERM drains a live epoch, not a compile
+    deadline = time.time() + 300
+    while not os.path.exists(os.path.join(tp.run_dir, "ckpt_0.npz")):
+        assert time.time() < deadline, "tp job never published ckpt_0"
+        assert tp.state == "running"
+        c.step()
+        time.sleep(0.5)
+    c.submit(JobSpec(
+        name="filler", kind="training", priority=1,
+        min_world=2, max_world=2,
+        argv=(sys.executable, "-c", "import time; time.sleep(600)"),
+    ))
+    # the plan shrinks tp-job 4 -> 2 through the drain; keep ticking until
+    # the TP job finishes all 6 epochs at the smaller shape
+    assert c.run_until(
+        lambda ctl: ctl.jobs["tp-job"].state in ("done", "failed"),
+        poll=0.5, timeout=480,
+    )
+    assert tp.state == "done", (tp.state, tp.exit_code)
+    assert tp.resizes >= 1
+    c.stop_job("filler")
+    c.shutdown(timeout=60)
+
+    assert history_epochs(tp.run_dir) == list(range(6))
+    events = topology_events(tp.run_dir)
+    assert events and (events[0]["from_world"], events[0]["to_world"]) == (4, 2)
+    assert (events[0]["from_model"], events[0]["to_model"]) == (2, 2)
+    metas = [
+        r for r in history_records(tp.run_dir)
+        if r.get("type") == "run_meta" and r.get("resumed_from_world")
+    ]
+    # the relaunched child derived data = 2 // 2 = 1 from the pinned width
+    assert metas and metas[0]["mesh"]["data"] == 1
+    assert metas[0]["mesh"]["model"] == 2
+    validate_history(tp.run_dir)
 
 
 def test_supervisor_end_to_end_preempt_then_resume(tmp_path):

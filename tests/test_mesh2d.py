@@ -556,9 +556,12 @@ def test_dp_checkpoint_refused_on_tp_mesh(cpu_devices, tmp_path):
         ckpt.load(v1, st, world_size=4, model_size=2)
 
 
-def test_tp_residual_data_resharding_deferred(cpu_devices, tmp_path):
-    """Changing the DATA width under TP with an EF residual armed refuses
-    (the (data, model)-keyed slices have no row-group redistribution)."""
+def test_tp_residual_data_resharding_requires_opt_in(cpu_devices, tmp_path):
+    """Changing the DATA width under TP with an EF residual armed refuses by
+    default — the (data, model)-keyed slices need the per-model-column
+    redistribution in tpuddp.training.reshard, and the refusal names BOTH
+    opt-in spellings (reshard_on_mismatch, the offline tool) so the operator
+    is pointed at the fix, not just the wall (ISSUE 16 satellite)."""
     tp, _ = make_tp(cpu_devices, comm_hook="bf16_ef")
     st = tp.init_state(KEY, jnp.zeros((1, T), jnp.int32))
     ckpt.save_on_main(str(tmp_path), 0, st, world_size=4)
@@ -568,10 +571,13 @@ def test_tp_residual_data_resharding_deferred(cpu_devices, tmp_path):
     smaller = dataclasses.replace(
         st, comm_state=jnp.zeros((st.comm_state.shape[0] // 2,), jnp.float32)
     )
-    with pytest.raises(ckpt.TopologyMismatch, match="deferred"):
+    with pytest.raises(
+        ckpt.TopologyMismatch, match="reshard_on_mismatch"
+    ) as err:
         ckpt.load(
             str(tmp_path / "ckpt_0.npz"), smaller, world_size=2, model_size=2
         )
+    assert "tpuddp_inspect reshard" in str(err.value)
 
 
 # ----------------------------------------------------------- wrap refusals --

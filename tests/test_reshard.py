@@ -1,0 +1,521 @@
+"""Cross-topology checkpoint resharding (ISSUE 16), fast tier.
+
+The tentpole module under test is ``tpuddp.training.reshard`` — the pure
+checkpoint -> checkpoint reshaper the supervisor/fleet relaunch path and the
+``tpuddp_inspect reshard`` CLI share. Pins:
+
+- the format-constant and placement-rule-table mirrors against the live
+  checkpoint writer (drift here silently corrupts offline reshapes);
+- ``redistribute_rows`` == ``comm.redistribute_residual`` bitwise;
+- the W -> W' -> W round trip is byte-identical through a model-width
+  crossing (QKV relayout is a pure reshape both ways);
+- synthesized placement tags (model=1 -> model>1) match what a real TP save
+  derives from live shardings;
+- per-replica residual redistribution per model column, data_flat re-pad,
+  and the typed refusals (v1 files, non-dividing widths, data_flat under
+  model>1) — plus the regression that ORDINARY refusals survive: a
+  wrong-shape head or a dtype flip still fails loudly with
+  ``reshard_on_mismatch`` enabled;
+- the stale-``.tmp`` sweep, the config/env levers, the supervisor's
+  mesh-aware shrink ladder, the fleet gang clamp, and the two new
+  ``tpuddp_inspect`` subcommands in-process.
+
+The chaos-tier proofs (kill a live TP=2 x DP=2 job, resume smaller with
+loss parity) live in tests/test_chaos.py.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuddp import config as cfg_lib
+from tpuddp import nn, optim
+from tpuddp.fleet.spec import FleetAdmissionError, JobSpec
+from tpuddp.models import load_model
+from tpuddp.parallel.comm import redistribute_residual
+from tpuddp.parallel.ddp import DistributedDataParallel
+from tpuddp.parallel.mesh2d import mesh2d
+from tpuddp.resilience.supervisor import RestartSupervisor, SupervisorPolicy
+from tpuddp.training import checkpoint as ckpt
+from tpuddp.training import reshard as rs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+KEY = jax.random.PRNGKey(0)
+V, T = 64, 16
+
+
+def _inspect():
+    spec = importlib.util.spec_from_file_location(
+        "_tpuddp_inspect", os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def tp_state(cpu_devices, data=2, model=2, **kw):
+    """A real TP state on a (data, model) mesh — the cheap test_mesh2d
+    idiom: init only, no training, so tier-1 stays fast."""
+    m = load_model("transformer_tiny", num_classes=V, max_seq_len=32)
+    ddp = DistributedDataParallel(
+        m, optim.Adam(lr=1e-2), nn.CrossEntropyLoss(),
+        mesh=mesh2d(data, model, devices=cpu_devices[: data * model]), **kw,
+    )
+    st = ddp.init_state(KEY, jnp.zeros((1, T), jnp.int32))
+    return ddp, st
+
+
+def save_tp(cpu_devices, tmp_path, data=2, model=2, epoch=0, **kw):
+    ddp, st = tp_state(cpu_devices, data, model, **kw)
+    path = ckpt.save_on_main(str(tmp_path), epoch, st, world_size=data * model)
+    return ddp, st, path
+
+
+def load_npz(path):
+    with np.load(path) as f:
+        return dict(f.items())
+
+
+def payload_equal(a, b, ignore=()):
+    """Byte-identical npz payloads (modulo ``ignore``d keys and the
+    topology record, whose ``resharded`` provenance legitimately differs)."""
+    ka = {k for k in a if k != rs.TOPO_MARK and k not in ignore}
+    kb = {k for k in b if k != rs.TOPO_MARK and k not in ignore}
+    assert ka == kb, ka.symmetric_difference(kb)
+    for k in ka:
+        x, y = np.asarray(a[k]), np.asarray(b[k])
+        assert x.dtype == y.dtype and x.shape == y.shape, (k, x.dtype, x.shape, y.dtype, y.shape)
+        np.testing.assert_array_equal(x, y, err_msg=k)
+
+
+# ---------------------------------------------------------- mirror drift --
+
+
+def test_format_marks_mirror_checkpoint_module():
+    """reshard.py duplicates the npz markers so it imports without jax; any
+    drift silently mis-classifies every leaf of every offline reshape."""
+    assert rs.KEY_MARK == ckpt._KEY_MARK
+    assert rs.BF16_MARK == ckpt._BF16_MARK
+    assert rs.META_MARK == ckpt._META_MARK
+    assert rs.TOPO_MARK == ckpt._TOPO_MARK
+    assert rs.FORMAT_VERSION == ckpt.FORMAT_VERSION
+
+
+def test_redistribute_rows_mirrors_comm_rule():
+    """The numpy mirror must be bitwise the live elastic rule — shrink
+    (grouped sum), grow (verbatim placement), and the M-nmid-N reset."""
+    mat = (
+        np.random.default_rng(7).normal(size=(4, 5)).astype(np.float32)
+    )
+    for new_world in (1, 2, 4, 8, 3):
+        ours, act_ours = rs.redistribute_rows(mat, new_world)
+        live, act_live = redistribute_residual(mat, new_world)
+        assert act_ours == act_live
+        np.testing.assert_array_equal(ours, live, err_msg=f"world {new_world}")
+
+
+def test_placement_rule_table_matches_live_tp_save(cpu_devices, tmp_path):
+    """The static TP_PLACEMENT_RULES table vs what derive_topology records
+    from live NamedShardings on a real TP=2 save: resharding a canonical
+    (model=1) file up to model=2 must synthesize EXACTLY the tags the live
+    writer would have derived — params and path-congruent moments both."""
+    _, _, path = save_tp(cpu_devices, tmp_path)
+    live = ckpt.read_topology(path)
+    assert live["model_size"] == 2 and live["placement"]
+
+    stored = load_npz(path)
+    canonical, topo1, _ = rs.reshard_arrays(stored, data=4, model=1)
+    assert topo1["model_size"] == 1
+    back, topo2, _ = rs.reshard_arrays(canonical, data=2, model=2)
+
+    def norm(placement):
+        # live tags spell replicated trailing dims explicitly for some
+        # leaves (['model', None]); synthesized tags trim them — identical
+        # shardings, so compare modulo the trailing-None spelling
+        out = {}
+        for k, axes in placement.items():
+            axes = list(axes)
+            while axes and axes[-1] is None:
+                axes.pop()
+            out[k] = axes
+        return out
+
+    assert norm(topo2["placement"]) == norm(live["placement"])
+
+
+# --------------------------------------------------------- the round trip --
+
+
+def test_round_trip_through_model_crossing_is_bitwise(cpu_devices, tmp_path):
+    """W -> W' -> W through the TP=2 -> canonical -> TP=2 crossing: every
+    array byte-identical (the QKV relayout is a pure reshape both ways, and
+    full gathered params/moments are mesh-shape-independent)."""
+    _, _, path = save_tp(cpu_devices, tmp_path)
+    stored = load_npz(path)
+    down, _, acts_down = rs.reshard_arrays(stored, data=2, model=1)
+    back, topo, acts_up = rs.reshard_arrays(down, data=2, model=2)
+    # the crossing touched the fused-QKV leaves both ways (param + moments)
+    relayouts = [a["leaf"] for a in acts_down if a["action"] == "relayout"]
+    assert any(leaf.endswith("['attn']['wqkv']") for leaf in relayouts)
+    assert len(acts_down) == len(acts_up) == len(relayouts)
+    payload_equal(stored, back)
+    assert topo["resharded"]["from"] == [2, 1]
+    assert topo["resharded"]["to"] == [2, 2]
+
+
+def test_same_shape_target_is_identity(cpu_devices, tmp_path):
+    _, _, path = save_tp(cpu_devices, tmp_path)
+    stored = load_npz(path)
+    out, _, actions = rs.reshard_arrays(stored, data=2, model=2)
+    assert actions == []
+    payload_equal(stored, out)
+
+
+def test_reshard_checkpoint_writes_manifest_and_is_loadable(
+    cpu_devices, tmp_path
+):
+    """File-level wrapper: atomic publish + fresh sha256 manifest, and the
+    result restores onto the target mesh without the reshard-on-load path
+    (the file IS the target shape now)."""
+    from tpuddp.resilience import integrity
+
+    _, st, path = save_tp(cpu_devices, tmp_path)
+    dst = os.path.join(str(tmp_path), "ckpt_0.d2m1.npz")
+    report = rs.reshard_checkpoint(path, dst, data=2, model=1)
+    assert report["from"] == {"data": 2, "model": 2}
+    assert report["to"] == {"data": 2, "model": 1}
+    assert integrity.verify_file(dst, require_manifest=True)
+    assert not os.path.exists(dst + ".tmp")
+
+    topo = ckpt.read_topology(dst)
+    assert topo["model_size"] == 1 and topo["mesh_axes"] == ["data"]
+    # loads as a plain model=1 checkpoint (canonical QKV layout) — no width
+    # mismatch, no opt-in; the model-replicated embed survives bitwise
+    _, st1 = tp_state(cpu_devices, data=2, model=1)
+    restored, _ = ckpt.load_with_topology(dst, st1, world_size=2)
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["embed"]["weight"]),
+        np.asarray(st.params["embed"]["weight"]),
+    )
+
+
+# ------------------------------------------- shape-dependent flat leaves --
+
+
+def synthetic_payload(data=2, model=2, per=6, with_comm=True):
+    """A hand-built v3 payload: one replicated param + a per-replica
+    comm_state laid out data-major/model-minor, exactly like a shard_map
+    bf16_ef save on a (data, model) mesh."""
+    world = data * model
+    param = np.arange(8, dtype=np.float32).reshape(2, 4)
+    topo = {
+        "format": rs.FORMAT_VERSION,
+        "world_size": world,
+        "model_size": model,
+        "mesh_axes": ["data", "model"] if model > 1 else ["data"],
+        "mesh_shape": [data, model] if model > 1 else [data],
+        "leaves": {},
+        "placement": {},
+    }
+    stored = {
+        ".params['w']": param,
+        rs.META_MARK + "epoch": np.asarray(3),
+    }
+    if with_comm:
+        mat = (
+            np.random.default_rng(11)
+            .normal(size=(world, per))
+            .astype(np.float32)
+        )
+        mat[:, per - 1] = 0.0  # padding tail: raw < per
+        stored[".comm_state"] = mat.reshape(-1)
+        topo["leaves"][".comm_state"] = {
+            "kind": "per_replica", "world": world, "per": per, "model": model,
+        }
+        topo["placement"][".comm_state"] = [["data", "model"]]
+    stored[rs.TOPO_MARK] = np.asarray(json.dumps(topo))
+    return stored
+
+
+def test_per_replica_redistributes_per_model_column():
+    """Growing the data axis at fixed model width: each model column is an
+    independent pure-data residual — redistributed with the live rule,
+    column by column, in the data-major/model-minor layout."""
+    stored = synthetic_payload(data=2, model=2, per=6)
+    raw = 8  # the one (2, 4) param, replicated -> per-replica pad target
+    out, topo, actions = rs.reshard_arrays(stored, data=4, model=2)
+    per_to = rs._padded_total(raw, 4)
+    old = stored[".comm_state"].reshape(2, 2, 6)
+    new = out[".comm_state"].reshape(4, 2, per_to)
+    for m in range(2):
+        col = old[:, m, :]
+        if per_to != 6:
+            pad = np.zeros((2, per_to), np.float32)
+            pad[:, : min(6, per_to)] = col[:, : min(6, per_to)]
+            col = pad
+        want, act = redistribute_residual(col, 4)
+        assert act == "redistributed"
+        np.testing.assert_array_equal(new[:, m, :], want, err_msg=f"col {m}")
+    assert topo["leaves"][".comm_state"]["world"] == 8
+    assert any(a["leaf"] == ".comm_state" for a in actions)
+
+
+def test_per_replica_drops_across_model_widths():
+    """A model-width crossing DROPS the residual (slices key by model
+    shard) — recorded as a reset action and in the topology provenance, so
+    the loader's zero re-init is auditable."""
+    stored = synthetic_payload(data=2, model=2, per=6)
+    out, topo, actions = rs.reshard_arrays(stored, data=2, model=1)
+    assert ".comm_state" not in out
+    assert topo["resharded"]["dropped"] == [".comm_state"]
+    resets = [a for a in actions if a["action"] == "reset"]
+    assert resets and resets[0]["leaf"] == ".comm_state"
+
+
+def test_data_flat_repads_and_refuses_model_targets():
+    param = np.arange(8, dtype=np.float32).reshape(2, 4)
+    raw = param.size
+    vec = np.zeros(rs._padded_total(raw, 4), np.float32)
+    vec[:raw] = np.arange(raw, dtype=np.float32) + 1
+    topo = {
+        "format": rs.FORMAT_VERSION, "world_size": 4, "model_size": 1,
+        "mesh_axes": ["data"], "mesh_shape": [4],
+        "leaves": {".opt_state.m": {"kind": "data_flat"}},
+        "placement": {},
+    }
+    stored = {
+        ".params['w']": param,
+        ".opt_state.m": vec,
+        rs.TOPO_MARK: np.asarray(json.dumps(topo)),
+    }
+    out, _, actions = rs.reshard_arrays(stored, data=3, model=1)
+    want = np.zeros(rs._padded_total(raw, 3), np.float32)
+    want[:raw] = vec[:raw]
+    np.testing.assert_array_equal(out[".opt_state.m"], want)
+    assert [a["action"] for a in actions] == ["repadded"]
+    # WUS flat moments have no TP layout: model>1 targets are refused
+    with pytest.raises(rs.ReshardError, match="model>1"):
+        rs.reshard_arrays(stored, data=2, model=2)
+
+
+# ---------------------------------------------------------- the refusals --
+
+
+def test_v1_checkpoint_refused():
+    stored = {".params['w']": np.ones((2, 2), np.float32)}
+    with pytest.raises(rs.ReshardError, match="predates the topology"):
+        rs.reshard_arrays(stored, data=2, model=1)
+
+
+def test_non_dividing_model_width_refused(cpu_devices, tmp_path):
+    """transformer_tiny's model-split dims don't divide by 3 — the
+    feasibility check names the first offending leaf instead of writing a
+    torn file."""
+    _, _, path = save_tp(cpu_devices, tmp_path)
+    with pytest.raises(rs.ReshardError, match="does not divide"):
+        rs.reshard_arrays(load_npz(path), data=1, model=3)
+
+
+def test_wrong_shape_head_still_refused_with_reshard_enabled(
+    cpu_devices, tmp_path
+):
+    """Regression: reshard_on_mismatch widens the TOPOLOGY surface only.
+    A checkpoint from a different architecture (wrong-vocab head) must
+    still fail loudly at load, not be 'resharded' into the wrong model."""
+    save_tp(cpu_devices, tmp_path)
+    m = load_model("transformer_tiny", num_classes=V + 8, max_seq_len=32)
+    ddp = DistributedDataParallel(
+        m, optim.Adam(lr=1e-2), nn.CrossEntropyLoss(),
+        mesh=mesh2d(2, 2, devices=cpu_devices[:4]),
+    )
+    st = ddp.init_state(KEY, jnp.zeros((1, T), jnp.int32))
+    with pytest.raises(ValueError, match="the model expects"):
+        ckpt.restore_latest(
+            str(tmp_path), st, world_size=4, model_size=2,
+            reshard_on_mismatch=True,
+        )
+
+
+def test_dtype_mismatch_still_refused_with_reshard_enabled(
+    cpu_devices, tmp_path
+):
+    _, st, path = save_tp(cpu_devices, tmp_path)
+    cast = dataclasses.replace(
+        st,
+        params=jax.tree_util.tree_map(
+            lambda a: np.asarray(a, np.float64), st.params
+        ),
+    )
+    with pytest.raises(ValueError, match="dtype"):
+        ckpt.load_with_topology(
+            path, cast, world_size=4, model_size=2, reshard_on_mismatch=True,
+        )
+
+
+# ------------------------------------------------------- startup hygiene --
+
+
+def test_sweep_stale_tmp(tmp_path):
+    d = str(tmp_path)
+    for name in (
+        "ckpt_0.npz.tmp", "ckpt_1.npz.sha256.tmp", "ckpt_2.npz",
+        "ckpt_2.npz.sha256", "notes.tmp", "ckpt_x.npz.tmp",
+    ):
+        with open(os.path.join(d, name), "w") as f:
+            f.write("x")
+    assert ckpt.sweep_stale_tmp(d) == 2
+    left = sorted(os.listdir(d))
+    assert left == ["ckpt_2.npz", "ckpt_2.npz.sha256", "ckpt_x.npz.tmp",
+                    "notes.tmp"]
+    assert ckpt.sweep_stale_tmp(d) == 0
+    assert ckpt.sweep_stale_tmp(os.path.join(d, "missing")) == 0
+
+
+# ------------------------------------------------------ config + levers --
+
+
+def test_reshard_knob_defaults_off_and_unknown_key_refused():
+    assert cfg_lib.TRAINING_DEFAULTS["reshard_on_mismatch"] is False
+    with pytest.raises(ValueError, match="unknown"):
+        cfg_lib.training_config({"training": {"reshard_on_mismtach": True}})
+
+
+def test_model_size_env_overrides_parallel_block(monkeypatch):
+    """$TPUDDP_MODEL_SIZE is the relaunch lever: it pins the width AND
+    resets an explicit data factorization to auto (it was for the old
+    world)."""
+    monkeypatch.delenv("TPUDDP_MODEL_SIZE", raising=False)
+    base = cfg_lib.resolve_parallel({"data": 2, "model": 2})
+    assert base["data"] == 2 and base["model"] == 2
+    monkeypatch.setenv("TPUDDP_MODEL_SIZE", "1")
+    over = cfg_lib.resolve_parallel({"data": 2, "model": 2})
+    assert over["model"] == 1 and over["data"] == "auto"
+
+
+# ------------------------------------------- supervisor mesh-aware shrink --
+
+
+def sup(world, model=None, **pol):
+    policy = SupervisorPolicy(**pol) if pol else None
+    return RestartSupervisor(
+        ["true"], policy=policy, world_size=world, model_size=model,
+        runner=lambda argv, env: 0,
+    )
+
+
+def test_shrunk_mesh_data_axis_first():
+    assert sup(8, 2)._shrunk_mesh() == (4, 2)
+    assert sup(4, 2)._shrunk_mesh() == (2, 2)
+
+
+def test_shrunk_mesh_model_axis_only_at_data_one():
+    # data=1: the model axis itself halves (the reshaper re-splits leaves)
+    assert sup(2, 2)._shrunk_mesh() == (1, 1)
+    assert sup(4, 4)._shrunk_mesh() == (2, 2)
+
+
+def test_shrunk_mesh_respects_min_world_and_divisibility():
+    assert sup(4, 2, min_world=4)._shrunk_mesh() is None
+    assert sup(2, 2, min_world=2)._shrunk_mesh() is None
+    # shrink_factor 3 divides neither data=1's model=2 nor leaves data >= 1
+    assert sup(2, 2, shrink_factor=3)._shrunk_mesh() is None
+    # pure DP unchanged: plain halving with the floor
+    assert sup(4)._shrunk_mesh() == (2, None)
+    assert sup(2, min_world=2)._shrunk_mesh() is None
+
+
+def test_supervisor_refuses_non_mesh_world_model():
+    with pytest.raises(ValueError, match="not a multiple"):
+        sup(6, 4)
+
+
+def test_supervisor_exports_model_env():
+    s = sup(4, 2)
+    env = s._child_env(attempt=0)
+    assert env["TPUDDP_MODEL_SIZE"] == "2"
+    assert env["TPUDDP_WORLD_SIZE"] == "4"
+    assert "TPUDDP_MODEL_SIZE" not in sup(4)._child_env(attempt=0)
+
+
+# ------------------------------------------------------- fleet gang math --
+
+
+def test_jobspec_model_size_admission():
+    ok = JobSpec(name="tp", kind="training", priority=0, min_world=2,
+                 max_world=4, model_size=2, argv=("true",))
+    assert ok.model_size == 2
+    with pytest.raises(FleetAdmissionError):
+        JobSpec(name="bad", kind="serving", priority=0, min_world=2,
+                max_world=4, model_size=2, argv=("true",))
+    with pytest.raises(FleetAdmissionError):
+        JobSpec(name="bad", kind="training", priority=0, min_world=3,
+                max_world=4, model_size=2, argv=("true",))
+    with pytest.raises(FleetAdmissionError):
+        JobSpec(name="bad", kind="training", priority=0, min_world=2,
+                max_world=2, model_size=0, argv=("true",))
+
+
+def test_gang_world_clamps_to_model_multiples():
+    from tpuddp.fleet.controller import FleetController
+
+    spec = JobSpec(name="tp", kind="training", priority=0, min_world=2,
+                   max_world=8, model_size=2, argv=("true",))
+    gang = FleetController._gang_world
+    assert gang(spec, 8) == 8
+    assert gang(spec, 7) == 6
+    assert gang(spec, 3) == 2
+    assert gang(spec, 1) == 2  # floored to min_world (a valid multiple)
+    dp = JobSpec(name="dp", kind="training", priority=0, min_world=1,
+                 max_world=8, argv=("true",))
+    assert gang(dp, 3) == 3  # model_size=1 jobs are untouched
+
+
+# ----------------------------------------------------------------- CLI --
+
+
+def test_inspect_ckpt_and_reshard_cli(cpu_devices, tmp_path, capsys):
+    _, _, path = save_tp(cpu_devices, tmp_path)
+    with open(os.path.join(str(tmp_path), "ckpt_7.npz.tmp"), "w") as f:
+        f.write("orphan")
+    insp = _inspect()
+
+    assert insp.main(["ckpt", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "1 checkpoint(s), 1 stale .tmp file(s)" in out
+    assert "mesh" in out and "model" in out
+
+    assert insp.main(["ckpt", path]) == 0
+    out = capsys.readouterr().out
+    assert "placement" in out and "manifest" in out
+
+    assert insp.main(["reshard", path, "--to", "data=2,model=1"]) == 0
+    out = capsys.readouterr().out
+    dst = path[: -len(".npz")] + ".d2m1.npz"
+    assert os.path.exists(dst)
+    assert "relayout" in out
+    assert ckpt.read_topology(dst)["model_size"] == 1
+
+    # the refusal surfaces as REFUSED + rc 1, not a stack trace
+    assert insp.main(["reshard", path, "--to", "data=1,model=3"]) == 1
+    err = capsys.readouterr().err
+    assert "REFUSED" in err and "does not divide" in err
+
+
+def test_inspect_reshard_round_trip_cli(cpu_devices, tmp_path, capsys):
+    _, _, path = save_tp(cpu_devices, tmp_path)
+    insp = _inspect()
+    down = os.path.join(str(tmp_path), "down.npz")
+    back = os.path.join(str(tmp_path), "back.npz")
+    assert insp.main(["reshard", path, "--to", "data=4,model=1",
+                      "--out", down]) == 0
+    assert insp.main(["reshard", down, "--to", "data=2,model=2",
+                      "--out", back]) == 0
+    capsys.readouterr()
+    payload_equal(load_npz(path), load_npz(back))

@@ -89,7 +89,17 @@ The merged history.jsonl must validate and carry a topology_change event
 row; elastic restore drifting (a reshard that crashes, or stops recording
 its provenance) fails the gate here.
 
-Fleet gate (after the elastic gate): ``tools/fleet.py chaos-demo`` shares
+Reshard gate (after the elastic gate): the ISSUE 16 cross-topology leg — a
+TP=2 x DP=2 token-LM run is preempted at an epoch boundary (exit 75,
+emergency v3 checkpoint with per-leaf placement tags); the checkpoint is
+round-tripped offline through ``tpuddp_inspect reshard`` across the
+model-width crossing (TP -> canonical -> TP) and must come back
+byte-identical; then the same run dir resumes at TP=1 x DP=2 through the
+reshard-on-load path and the merged history must validate and carry the
+``(model 2 -> 1)`` topology_change event. Placement-tag drift, a lossy QKV
+relayout, or a reshard that stops recording provenance fails here.
+
+Fleet gate (after the reshard gate): ``tools/fleet.py chaos-demo`` shares
 one CPU-mesh pool between 2 training jobs and 1 serving job under the
 fleet controller (ISSUE 11): one training job is SIGKILLed mid-run and
 resumes elastically, a late high-priority arrival preempts capacity
@@ -534,6 +544,111 @@ def _elastic_gate(env) -> int:
         if not any(r.get("event") == "topology_change" for r in records):
             print("elastic gate: no topology_change event row in the resumed "
                   "history", file=sys.stderr)
+            return 1
+    return 0
+
+
+def _reshard_gate(env) -> int:
+    """Elastic mesh failover (ISSUE 16): preempt a TP=2 x DP=2 job, round-trip
+    its emergency checkpoint offline (W -> W' -> W byte-identical through the
+    model-width crossing), then resume it at TP=1 x DP=2 — the reshard-on-load
+    path — and validate the merged history names the episode."""
+    import json
+
+    import numpy as np
+
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    worker = os.path.join(REPO, "tests", "_chaos_tp_worker.py")
+    with tempfile.TemporaryDirectory(prefix="tpuddp_reshard_gate_") as out_dir:
+        base_env = dict(env)
+        base_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        # leg 1: TP=2 x DP=2 (the worker's default mesh), drained at the
+        # epoch-1 boundary -> exit 75 + an emergency v3 checkpoint
+        env1 = dict(base_env)
+        env1.update({
+            "TPUDDP_WORLD_SIZE": "4",
+            "TPUDDP_FAULT": "preempt@epoch=1",
+        })
+        rc = subprocess.call(
+            [sys.executable, "-u", worker, out_dir, "3"],
+            cwd=REPO, env=env1,
+        )
+        if rc != 75:
+            print(f"reshard gate: preempted TP run exited {rc}, expected 75",
+                  file=sys.stderr)
+            return rc or 1
+        src = os.path.join(out_dir, "ckpt_1.npz")
+        # leg 2: the offline round trip through the CLI — TP layout ->
+        # canonical -> TP layout must be byte-identical
+        down = os.path.join(out_dir, "rt_down.npz")
+        back = os.path.join(out_dir, "rt_back.npz")
+        for args in (
+            [src, "--to", "data=4,model=1", "--out", down],
+            [down, "--to", "data=2,model=2", "--out", back],
+        ):
+            rc = subprocess.call(
+                [sys.executable, inspect, "reshard", *args],
+                cwd=REPO, env=env,
+            )
+            if rc != 0:
+                print(f"reshard gate: tpuddp_inspect reshard {args} exited "
+                      f"{rc}", file=sys.stderr)
+                return rc
+        with np.load(src) as f:
+            want = dict(f.items())
+        with np.load(back) as f:
+            got = dict(f.items())
+        keys = {k for k in want if k != "__topology__"}
+        if keys != {k for k in got if k != "__topology__"}:
+            print("reshard gate: round trip changed the leaf set",
+                  file=sys.stderr)
+            return 1
+        for k in keys:
+            if not np.array_equal(want[k], got[k]):
+                print(f"reshard gate: round trip not byte-identical at {k}",
+                      file=sys.stderr)
+                return 1
+        # leg 3: resume the SAME run dir at TP=1 x DP=2 — the in-loader
+        # reshard (worker sets training.reshard_on_mismatch) re-splits the
+        # model-axis leaves onto the surviving mesh
+        env3 = dict(base_env)
+        env3.update({
+            "TPUDDP_WORLD_SIZE": "2",
+            "TPUDDP_MODEL_SIZE": "1",
+            "TPUDDP_AUTO_RESUME": "1",
+        })
+        rc = subprocess.call(
+            [sys.executable, "-u", worker, out_dir, "3"],
+            cwd=REPO, env=env3,
+        )
+        if rc != 0:
+            print(f"reshard gate: cross-shape resume exited {rc}",
+                  file=sys.stderr)
+            return rc
+        history = os.path.join(out_dir, "history.jsonl")
+        rc = subprocess.call(
+            [sys.executable, inspect, "--validate", history],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("reshard gate: merged history.jsonl failed validation",
+                  file=sys.stderr)
+            return rc
+        with open(history) as f:
+            records = [json.loads(line) for line in f if line.strip()]
+        changes = [
+            r for r in records if r.get("event") == "topology_change"
+        ]
+        if not any(
+            r.get("from_model") == 2 and r.get("to_model") == 1
+            for r in changes
+        ):
+            print("reshard gate: no (model 2 -> 1) topology_change event in "
+                  "the resumed history", file=sys.stderr)
             return 1
     return 0
 
@@ -1253,6 +1368,9 @@ def main(argv=None):
     if rc != 0:
         return rc
     rc = _elastic_gate(env)
+    if rc != 0:
+        return rc
+    rc = _reshard_gate(env)
     if rc != 0:
         return rc
     rc = _fleet_gate(env)

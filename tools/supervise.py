@@ -8,9 +8,10 @@ contract the training processes already speak (README "Fault tolerance"):
     75  preemption drain             -> resume IMMEDIATELY (auto-resume env)
     76  stale peer (watchdog)        -> jittered-backoff restart; after
                                         --shrink-after consecutive 76s,
-                                        SHRINK the world (--world // factor,
-                                        via $TPUDDP_WORLD_SIZE) and resume
-                                        through the elastic v2 restore
+                                        SHRINK the mesh (data axis first,
+                                        model axis only at data=1; via
+                                        $TPUDDP_WORLD_SIZE/$TPUDDP_MODEL_SIZE)
+                                        and resume through the elastic restore
     77  replica desync               -> jittered-backoff restart + resume
     *   anything else non-zero       -> jittered-backoff restart + resume,
                                         bounded by --max-restarts
@@ -52,6 +53,12 @@ def parse_args(argv=None):
     parser.add_argument("--world", type=int, default=None,
                         help="initial world size (pins $TPUDDP_WORLD_SIZE; "
                         "required for elastic shrink)")
+    parser.add_argument("--model", type=int, default=None,
+                        help="tensor-parallel width (pins $TPUDDP_MODEL_SIZE); "
+                        "arms MESH-aware shrink: data axis halves first, the "
+                        "model axis shrinks only once data=1 — the child "
+                        "reshards its checkpoint onto the smaller mesh "
+                        "(training.reshard_on_mismatch)")
     parser.add_argument("--max-restarts", type=int, default=8,
                         help="total restart budget across all causes")
     parser.add_argument("--backoff-base", type=float, default=1.0,
@@ -116,6 +123,7 @@ def main(argv=None) -> int:
         command,
         policy=policy,
         world_size=args.world,
+        model_size=args.model,
         first_attempt_env=first_env,
         auto_resume_first=args.auto_resume,
         flight_dir=args.flight_dir,

@@ -21,8 +21,23 @@ attributes every epoch row to the header that OWNS it: the per-epoch table
 gains a ``run`` column and the grad-comm savings line uses only the latest
 run segment, so pre- and post-resume worlds never mix in one figure.
 
+Checkpoint subcommands (numpy, no jax — both run on analysis hosts):
+
+- ``ckpt <file-or-dir>`` — summarize a format-v3 checkpoint: the recorded
+  ``(data, model)`` topology, per-leaf placement tags, shard-tagged flat
+  leaves, reshard provenance, and the sha256 manifest status. Pointed at a
+  run dir it lists every ``ckpt_*.npz`` (+ stale ``.tmp`` debris count)
+  and summarizes the newest.
+- ``reshard <src> --to data=D,model=M [--out PATH]`` — the offline
+  cross-topology reshaper (tpuddp/training/reshard.py): rewrite a
+  checkpoint saved on one mesh shape for another, atomically, with a fresh
+  manifest — what ``training.reshard_on_mismatch: true`` does at load
+  time, runnable before the relaunch instead.
+
 Usage:
     python tools/tpuddp_inspect.py <path> [--validate] [--events]
+    python tools/tpuddp_inspect.py ckpt <file-or-dir>
+    python tools/tpuddp_inspect.py reshard <src> --to data=D,model=M
 
 ``--validate`` checks the schema only (exit 0 valid / 1 invalid, errors on
 stderr) — the mode ``tools/run_full_gate.py`` runs over the dryrun history
@@ -52,6 +67,30 @@ def _load_schema():
     where the accelerator runtime is absent."""
     path = os.path.join(_REPO, "tpuddp", "observability", "schema.py")
     spec = importlib.util.spec_from_file_location("_tpuddp_inspect_schema", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_reshard():
+    """Load tpuddp/training/reshard.py by file path — numpy + stdlib only,
+    same rationale as _load_schema: the checkpoint subcommands must work
+    where the accelerator runtime is absent."""
+    path = os.path.join(_REPO, "tpuddp", "training", "reshard.py")
+    spec = importlib.util.spec_from_file_location(
+        "_tpuddp_inspect_reshard", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_integrity():
+    """tpuddp/resilience/integrity.py by file path (stdlib-only module)."""
+    path = os.path.join(_REPO, "tpuddp", "resilience", "integrity.py")
+    spec = importlib.util.spec_from_file_location(
+        "_tpuddp_inspect_integrity", path
+    )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
@@ -589,8 +628,167 @@ def summarize_bench(path: str) -> None:
     ])
 
 
+def summarize_ckpt(path: str) -> int:
+    """Print one checkpoint's recorded topology, shard tags, placement
+    table, and manifest status. Returns 0 (1 when the manifest mismatches —
+    a torn file an operator should know about before trusting it)."""
+    import numpy as np
+
+    reshard = _load_reshard()
+    integrity = _load_integrity()
+    with np.load(path) as f:
+        stored = dict(f.items())
+    topo = reshard.parse_topology(stored)
+    leaves = [
+        k for k in stored
+        if k != reshard.TOPO_MARK and not k.startswith(reshard.META_MARK)
+    ]
+    n_bf16 = sum(1 for k in leaves if k.startswith(reshard.BF16_MARK))
+    n_keys = sum(1 for k in leaves if k.startswith(reshard.KEY_MARK))
+    total_b = sum(int(stored[k].nbytes) for k in leaves)
+    print(f"checkpoint: {path}")
+    print(f"  leaves: {len(leaves)} ({n_bf16} bf16-packed, {n_keys} PRNG "
+          f"key(s)), {total_b:,} payload bytes")
+    if topo is None:
+        print("  topology: MISSING (format v1 — predates shard provenance; "
+              "resharding refuses this file, resume it at model=1 or re-save "
+              "through save_on_main)")
+    else:
+        d, m = reshard.topology_shape(topo)
+        print(f"  topology: format v{topo.get('format')} world="
+              f"{topo.get('world_size')} mesh=(data={d}, model={m}) "
+              f"axes={topo.get('mesh_axes')}")
+        re_prov = topo.get("resharded")
+        if re_prov:
+            print(f"  resharded: {re_prov.get('from')} -> {re_prov.get('to')}"
+                  + (f", dropped {re_prov['dropped']}"
+                     if re_prov.get("dropped") else ""))
+        tags = topo.get("leaves") or {}
+        if tags:
+            print(f"  shard-tagged flat leaves ({len(tags)}):")
+            for k in sorted(tags):
+                print(f"    {k}: {tags[k]}")
+        placement = topo.get("placement") or {}
+        if placement:
+            print(f"  placement tags ({len(placement)}):")
+            for k in sorted(placement):
+                print(f"    {k}: {placement[k]}")
+        else:
+            print("  placement tags: none (every leaf replicated)")
+    manifest = integrity.read_manifest(path)
+    if manifest is None:
+        print("  manifest: ABSENT (.sha256 sidecar missing — structural "
+              "zip check only at restore)")
+        return 0
+    ok = integrity.verify_file(path, require_manifest=True)
+    status = (
+        "verified"
+        if ok
+        else "MISMATCH (torn file: restore will skip this candidate)"
+    )
+    print(f"  manifest: sha256={manifest['digest'][:12]}... "
+          f"size={manifest['size']} -> {status}")
+    return 0 if ok else 1
+
+
+def ckpt_main(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpuddp_inspect.py ckpt",
+        description="Summarize a tpuddp checkpoint (topology record, "
+        "placement tags, manifest status) or a checkpoint directory.",
+    )
+    parser.add_argument("path", help="ckpt_<epoch>.npz file, or a run dir")
+    args = parser.parse_args(argv)
+    if os.path.isdir(args.path):
+        import re as _re
+
+        names = sorted(os.listdir(args.path))
+        ckpts = [n for n in names if _re.match(r"^ckpt_\d+\.npz$", n)]
+        stale = [
+            n for n in names
+            if _re.match(r"^ckpt_\d+\.npz(\.sha256)?\.tmp$", n)
+        ]
+        print(f"{args.path}: {len(ckpts)} checkpoint(s), {len(stale)} stale "
+              ".tmp file(s)" + (f" {stale}" if stale else ""))
+        if not ckpts:
+            return 0
+        newest = max(ckpts, key=lambda n: int(n[len("ckpt_"):-len(".npz")]))
+        print()
+        return summarize_ckpt(os.path.join(args.path, newest))
+    if not os.path.isfile(args.path):
+        print(f"no such file: {args.path}", file=sys.stderr)
+        return 2
+    return summarize_ckpt(args.path)
+
+
+def reshard_main(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpuddp_inspect.py reshard",
+        description="Offline cross-topology checkpoint reshaper: rewrite a "
+        "format-v3 checkpoint saved on one (data, model) mesh for another "
+        "(atomic publish + fresh sha256 manifest). The load-time equivalent "
+        "is training.reshard_on_mismatch: true.",
+    )
+    parser.add_argument("src", help="source ckpt_<epoch>.npz")
+    parser.add_argument(
+        "--to", required=True, metavar="data=D,model=M",
+        help="target mesh shape, e.g. --to data=2,model=1",
+    )
+    parser.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="output file (default: <src stem>.d<D>m<M>.npz alongside src; "
+        "pass the src path itself to reshape in place)",
+    )
+    args = parser.parse_args(argv)
+    if not os.path.isfile(args.src):
+        print(f"no such file: {args.src}", file=sys.stderr)
+        return 2
+    shape = {}
+    for part in args.to.split(","):
+        if "=" not in part:
+            parser.error(f"--to expects data=D,model=M, got {args.to!r}")
+        k, v = part.split("=", 1)
+        shape[k.strip()] = v.strip()
+    unknown = set(shape) - {"data", "model"}
+    if unknown or "data" not in shape:
+        parser.error(f"--to expects data=D,model=M, got {args.to!r}")
+    try:
+        data = int(shape["data"])
+        model = int(shape.get("model", 1))
+    except ValueError:
+        parser.error(f"--to expects integer widths, got {args.to!r}")
+    out = args.out
+    if out is None:
+        stem = args.src[:-len(".npz")] if args.src.endswith(".npz") else args.src
+        out = f"{stem}.d{data}m{model}.npz"
+    reshard = _load_reshard()
+    try:
+        report = reshard.reshard_checkpoint(args.src, out, data, model)
+    except reshard.ReshardError as e:
+        print(f"REFUSED: {e}", file=sys.stderr)
+        return 1
+    f, t = report["from"], report["to"]
+    print(f"resharded {report['src']} -> {report['dst']}")
+    print(f"  mesh: (data={f['data']}, model={f['model']}) -> "
+          f"(data={t['data']}, model={t['model']}), "
+          f"{report['leaves']} leaves")
+    for a in report["actions"]:
+        detail = {
+            k: v for k, v in a.items() if k not in ("leaf", "action")
+        }
+        print(f"  {a['action']}: {a['leaf']} {detail}")
+    if not report["actions"]:
+        print("  (no per-leaf surgery needed: payloads are mesh-shape-"
+              "independent at these shapes)")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "ckpt":
+        return ckpt_main(argv[1:])
+    if argv and argv[0] == "reshard":
+        return reshard_main(argv[1:])
     # `tpuddp_inspect.py trace <path>` — the explicit trace subcommand:
     # validates the artifact against schema v9 and prints the slowest-span
     # table + per-kind time share (content detection still recognizes a

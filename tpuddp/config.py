@@ -93,6 +93,12 @@ TRAINING_DEFAULTS = {
     # checkpoint at loop entry (corrupt ones skipped; a preemption-drain
     # emergency save redoes its interrupted epoch). Env: TPUDDP_AUTO_RESUME=1
     # lets a scheduler requeue the exact same command after exit 75.
+    "reshard_on_mismatch": False,  # elastic mesh failover: a checkpoint
+    # written on a different (data, model) mesh shape is re-shaped in-memory
+    # by the cross-topology reshaper (training/reshard.py) at restore time
+    # instead of refusing with TopologyMismatch. Opt-in because a reshard
+    # can reset the error-feedback residual (model-width changes) — the
+    # reshard lands typed topology_change/comm_state_reset event rows.
     "keep_last": None,  # checkpoint retention: prune all but the K newest
     # ckpt_{epoch}.npz (+ .sha256 manifests) after each save; None keeps all
     "guard": None,  # numerical guard block (resilience/guard.py): true, or
@@ -293,12 +299,24 @@ def parallel_config(settings: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def resolve_parallel(block) -> Dict[str, Any]:
-    """Resolve a ``parallel`` block (None/dict) to the full knob dict."""
+    """Resolve a ``parallel`` block (None/dict) to the full knob dict.
+
+    ``$TPUDDP_MODEL_SIZE`` overrides the model width the way
+    ``$TPUDDP_WORLD_SIZE`` overrides the world: it is the restart
+    supervisor's / fleet controller's elastic-mesh lever — a relaunch after
+    capacity loss sets both so the child derives ``data = world / model``
+    on the surviving devices. The override also resets an explicit ``data``
+    to ``"auto"`` (the settings file's factorization was for the OLD world)."""
     if block is None:
-        return dict(PARALLEL_DEFAULTS)
-    if not isinstance(block, dict):
+        cfg = dict(PARALLEL_DEFAULTS)
+    elif not isinstance(block, dict):
         raise ValueError(f"parallel block must be a mapping, got {block!r}")
-    cfg = _merge_refusing_unknown(PARALLEL_DEFAULTS, block, "parallel")
+    else:
+        cfg = _merge_refusing_unknown(PARALLEL_DEFAULTS, block, "parallel")
+    env_model = os.environ.get("TPUDDP_MODEL_SIZE")
+    if env_model:
+        cfg["model"] = int(env_model)
+        cfg["data"] = "auto"
     model = int(cfg["model"])
     if model < 1:
         raise ValueError(f"parallel.model must be >= 1, got {cfg['model']!r}")

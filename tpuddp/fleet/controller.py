@@ -237,8 +237,23 @@ class FleetController:
             return job
 
     # -------------------------------------------------------------- spawn --
+    @staticmethod
+    def _gang_world(spec: JobSpec, world: int) -> int:
+        """Clamp a planned world to a (data, model)-factorable gang size.
+
+        The planner and autoscaler reason in raw chip counts; a TP job can
+        only gang-run at multiples of its model width (mesh_from refuses
+        anything else). Floor to the nearest multiple — min_world is
+        validated as a multiple at admission, so the floor never violates
+        gang semantics."""
+        m = spec.model_size or 1
+        if m <= 1:
+            return world
+        return max((world // m) * m, spec.min_world)
+
     def _start(self, job: ManagedJob, world: int) -> None:
         spec = job.spec
+        world = self._gang_world(spec, world)
         env = dict(self.env)
         env.update(spec.resolved_env(job.run_dir))
         policy = SupervisorPolicy(
@@ -259,6 +274,14 @@ class FleetController:
             flight_dir=job.run_dir,
             world_env_var=(
                 SERVING_WORLD_ENV if spec.kind == "serving" else WORLD_ENV
+            ),
+            # TP training jobs pin their model width ($TPUDDP_MODEL_SIZE) so
+            # every relaunch factors the handed world as (data, model) and
+            # the supervisor's capacity-loss shrink stays mesh-aware
+            model_size=(
+                spec.model_size
+                if spec.kind == "training" and spec.model_size > 1
+                else None
             ),
         )
         job.state = RUNNING
@@ -342,6 +365,7 @@ class FleetController:
     def _resize(self, job: ManagedJob, world: int) -> None:
         if job.supervisor is None:
             return
+        world = self._gang_world(job.spec, world)
         if job.supervisor.world_size == world:
             return
         logger.warning(

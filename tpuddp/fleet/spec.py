@@ -59,6 +59,10 @@ class JobSpec:
     env: Dict[str, str] = dataclasses.field(default_factory=dict)
     first_attempt_env: Dict[str, str] = dataclasses.field(default_factory=dict)
     max_restarts: int = 8
+    # tensor-parallel width (training jobs): every world the planner hands
+    # this job must factor as (data, model) — min/max_world and resizes are
+    # clamped to multiples of model_size; exported as $TPUDDP_MODEL_SIZE
+    model_size: int = 1
 
     def __post_init__(self):
         object.__setattr__(self, "argv", tuple(str(a) for a in self.argv))
@@ -98,6 +102,31 @@ class JobSpec:
                 "bad_spec",
                 f"job {self.name!r}: max_restarts must be >= 0",
             )
+        if self.model_size < 1:
+            raise FleetAdmissionError(
+                "bad_spec",
+                f"job {self.name!r}: model_size must be >= 1, got "
+                f"{self.model_size}",
+            )
+        if self.model_size > 1:
+            if self.kind != "training":
+                raise FleetAdmissionError(
+                    "bad_spec",
+                    f"job {self.name!r}: model_size applies to training "
+                    f"jobs only (got kind {self.kind!r})",
+                )
+            # gang worlds must factor as (data, model): a world that is not
+            # a multiple of model_size has no mesh, so refuse it at
+            # admission instead of at the child's mesh_from
+            for field in ("min_world", "max_world"):
+                w = getattr(self, field)
+                if w % self.model_size:
+                    raise FleetAdmissionError(
+                        "bad_spec",
+                        f"job {self.name!r}: {field} {w} is not a multiple "
+                        f"of model_size {self.model_size} — no (data, "
+                        f"model) mesh exists at that world",
+                    )
 
     # ------------------------------------------------------- substitution --
     def resolved_argv(self, run_dir: str) -> list:

@@ -28,6 +28,18 @@ exits with, and restarts it under the right policy —
   the elastic v2 restore path (training/checkpoint.py reshards the
   checkpoint onto the smaller world).
 
+Mesh-aware failover (ISSUE 16): ``model_size`` pins the child's
+tensor-parallel width (``$TPUDDP_MODEL_SIZE``, honored by
+``config.resolve_parallel`` the way ``$TPUDDP_WORLD_SIZE`` is by
+``world_size_from``). The shrink then picks the next FEASIBLE smaller mesh
+from the surviving devices: the DATA axis halves first (model shards keep
+the geometry that was validated for their width, and data-axis checkpoint
+resharding is the sum-preserving direction); only at data=1 does the MODEL
+axis shrink (when the factor divides it). The relaunched child derives
+``data = world / model`` and — with ``training.reshard_on_mismatch`` on —
+reshards the checkpoint onto the smaller mesh (training/reshard.py) instead
+of dying on the typed TopologyMismatch.
+
 Every restart is bounded by ``max_restarts``; exhaustion returns the child's
 last exit code so the wrapping scheduler still sees the truth.
 
@@ -58,6 +70,7 @@ from tpuddp.resilience.preemption import (
 logger = logging.getLogger("tpuddp")
 
 WORLD_ENV = "TPUDDP_WORLD_SIZE"
+MODEL_ENV = "TPUDDP_MODEL_SIZE"
 _AUTO_RESUME_ENV = "TPUDDP_AUTO_RESUME"
 _SPAWNED_ENV = "TPUDDP_SPAWNED"
 
@@ -136,6 +149,7 @@ class RestartSupervisor:
         rng: Optional[random.Random] = None,
         flight_dir: Optional[str] = None,
         world_env_var: str = WORLD_ENV,
+        model_size: Optional[int] = None,
     ):
         """``flight_dir``: where the supervised run dumps its crash flight
         recordings (``flightrec_<reason>.json`` — usually the run's
@@ -148,10 +162,25 @@ class RestartSupervisor:
         child. Training jobs use the default ``$TPUDDP_WORLD_SIZE``;
         serving jobs under the fleet controller use
         ``$TPUDDP_SERVING_REPLICAS`` (config.serving_config honors it), so
-        ONE drain -> resume contract resizes both kinds."""
+        ONE drain -> resume contract resizes both kinds.
+
+        ``model_size``: the child's tensor-parallel width, pinned via
+        ``$TPUDDP_MODEL_SIZE`` on every attempt. Arms the MESH-aware shrink:
+        data axis first, model axis only at data=1 (module doc). None =
+        the supervisor treats the world as pure-DP (today's behavior)."""
         self.argv = list(argv)
         self.policy = policy or SupervisorPolicy()
         self.world_size = int(world_size) if world_size else None
+        self.model_size = int(model_size) if model_size else None
+        if (
+            self.model_size
+            and self.world_size
+            and self.world_size % self.model_size
+        ):
+            raise ValueError(
+                f"world_size {self.world_size} is not a multiple of "
+                f"model_size {self.model_size}: no (data, model) mesh exists"
+            )
         self.env = dict(env or {})
         self.first_attempt_env = dict(first_attempt_env or {})
         self.auto_resume_first = bool(auto_resume_first)
@@ -252,7 +281,37 @@ class RestartSupervisor:
         world = self.world_size if world is None else world
         if world:
             env[self.world_env_var] = str(world)
+        if self.model_size:
+            # pin the tensor-parallel width; the child derives
+            # data = world // model (config.resolve_parallel honors this)
+            env[MODEL_ENV] = str(self.model_size)
         return env
+
+    # ----------------------------------------------------------- shrink --
+    def _shrunk_mesh(self) -> Optional[tuple]:
+        """The next-smaller feasible ``(world, model)`` mesh after sustained
+        capacity loss, or None when no shrink is possible.
+
+        Data axis shrinks first (replicas are interchangeable; a data
+        shrink is the cheap reshard — model shards keep their width). Only
+        at data=1 does the model axis shrink, and only when shrink_factor
+        divides it; the reshaper re-splits the model-axis leaves on
+        restore. ``min_world`` floors the TOTAL chip count either way."""
+        f = self.policy.shrink_factor
+        floor = max(1, self.policy.min_world)
+        world = self.world_size
+        if not world:
+            return None
+        model = self.model_size or 1
+        if model <= 1:
+            new_world = world // f
+            return (new_world, None) if new_world >= floor else None
+        data = world // model
+        if data // f >= 1 and (data // f) * model >= floor:
+            return ((data // f) * model, model)
+        if data == 1 and model % f == 0 and model // f >= floor:
+            return (model // f, model // f)
+        return None
 
     # ---------------------------------------------------------- flight --
     def summarize_flight(self) -> int:
@@ -337,21 +396,25 @@ class RestartSupervisor:
             consecutive_failures += 1
             if rc == EXIT_WATCHDOG:
                 consecutive_peer_deaths += 1
-                if (
-                    consecutive_peer_deaths >= self.policy.shrink_after
-                    and self.world_size
-                    and self.world_size // self.policy.shrink_factor
-                    >= max(1, self.policy.min_world)
-                ):
-                    new_world = self.world_size // self.policy.shrink_factor
+                shrunk = (
+                    self._shrunk_mesh()
+                    if consecutive_peer_deaths >= self.policy.shrink_after
+                    else None
+                )
+                if shrunk is not None:
+                    new_world, new_model = shrunk
                     logger.critical(
                         "supervisor: %d consecutive peer deaths (exit %d) — "
                         "the pod lost capacity, not a transient. Shrinking "
-                        "world %d -> %d and resuming through the elastic "
-                        "restore path.",
-                        consecutive_peer_deaths, rc, self.world_size, new_world,
+                        "mesh %d (model=%s) -> %d (model=%s) and resuming "
+                        "through the elastic restore path.",
+                        consecutive_peer_deaths, rc,
+                        self.world_size, self.model_size or 1,
+                        new_world, (new_model or self.model_size or 1),
                     )
                     self.world_size = new_world
+                    if new_model is not None:
+                        self.model_size = new_model
                     consecutive_peer_deaths = 0
                     consecutive_failures = 0
                     continue

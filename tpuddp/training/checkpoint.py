@@ -55,13 +55,15 @@ over). Parameter/moment leaves are stored as their FULL logical arrays (the
 single-controller save gathers shards transparently), so they are
 model-width-independent on disk — what is NOT width-independent is the
 per-``(data, model)``-device error-feedback residual. ``load`` /
-``restore_latest`` take the current ``model_size`` and REFUSE a
-cross-model-width restore with a typed :class:`TopologyMismatch` instead of
-mis-slicing: there is no resharding story for the model axis (elastic
-``model``-width resharding is explicitly deferred — README "2-D mesh").
-A v2 file written on a 2-D mesh carries the mesh axes/shape, so the same
-refusal applies to it; a v1/DP file loaded onto a TP run (or vice versa)
-refuses identically.
+``restore_latest`` take the current ``model_size``; by default a
+cross-model-width restore REFUSES with a typed :class:`TopologyMismatch`
+instead of mis-slicing. With ``reshard_on_mismatch=True`` (the
+``training.reshard_on_mismatch`` knob) the payload is first re-shaped
+in-memory by :mod:`tpuddp.training.reshard` — the cross-topology reshaper
+behind ``tpuddp_inspect reshard`` — and then loads on the target mesh; see
+that module's doc for the exact/reset contract (README "2-D mesh"). A v2
+file written on a 2-D mesh carries the mesh axes/shape, so the same rules
+apply to it; a v1 file (no topology record) still refuses either way.
 """
 
 from __future__ import annotations
@@ -262,18 +264,20 @@ def _check_model_width(path: str, topo: Optional[dict], model_size) -> None:
             raise TopologyMismatch(
                 f"checkpoint {path} predates the topology record (format v1) "
                 f"and cannot be restored onto a model={cur} tensor-parallel "
-                "mesh; resume it on a pure-DP world (model=1) or re-save it "
-                "through save_on_main first"
+                "mesh: it carries no shard provenance, so even the reshaper "
+                "refuses it. Resume it on a pure-DP world (model=1) or "
+                "re-save it through save_on_main (format v3) first."
             )
         return
     saved = topology_model_size(topo)
     if saved != cur:
         raise TopologyMismatch(
             f"checkpoint {path} was written on a model={saved} mesh but the "
-            f"current run is model={cur}: cross-model-width resharding is "
-            "not supported (elastic resharding covers the DATA axis only; "
-            "the model axis has no redistribution story — README '2-D "
-            "mesh'). Restore on a matching parallel.model width."
+            f"current run is model={cur}. Cross-topology restore is opt-in: "
+            "set training.reshard_on_mismatch=true to reshard on load, or "
+            "reshape the file offline with `tpuddp_inspect reshard "
+            f"--to data=D,model={cur}` (README '2-D mesh' documents which "
+            "reshapes are exact and which reset the comm residual)."
         )
 
 
@@ -405,13 +409,15 @@ def _fit_leaf(
     if info["kind"] == "per_replica":
         if int(info.get("model", 1) or 1) > 1:
             # a 2-D-mesh residual keys by (data_index, model_index); the
-            # row-group redistribution below assumes pure data rows, so a
-            # DATA-width change under tensor parallelism refuses instead of
-            # sum-merging across unrelated model shards
+            # row-group redistribution below assumes pure data rows. The
+            # reshaper (tpuddp.training.reshard) redistributes it per model
+            # column — this in-loader path refuses so the opt-in stays the
+            # single entry point for cross-topology fitting.
             raise TopologyMismatch(
                 f"checkpoint {path}: per-replica leaf {key!r} was written on "
-                f"a model={info['model']} mesh; elastic DATA-axis resharding "
-                "of a tensor-parallel error-feedback residual is deferred — "
+                f"a model={info['model']} mesh under a different data width; "
+                "set training.reshard_on_mismatch=true (or reshape offline "
+                "with `tpuddp_inspect reshard`) to redistribute it, or "
                 "resume on the same (data, model) grid"
             )
         if world_size is None:
@@ -474,17 +480,51 @@ def load_with_topology(
     world_size: Optional[int] = None,
     reshard_actions: Optional[List[dict]] = None,
     model_size: Optional[int] = None,
+    reshard_on_mismatch: bool = False,
 ) -> Tuple[Any, Optional[dict]]:
     """:func:`load` plus the file's parsed topology record (None for v1) —
     one file open for callers that need both (restore_latest, the managed
     load_state). ``model_size`` is the CURRENT tensor-parallel width (None =
     1, every pre-2-D caller); a width mismatch against the file's record is
-    a typed :class:`TopologyMismatch` BEFORE any leaf is touched."""
+    a typed :class:`TopologyMismatch` BEFORE any leaf is touched — unless
+    ``reshard_on_mismatch`` (the ``training.reshard_on_mismatch`` knob)
+    opts into the cross-topology reshaper, which re-shapes the payload
+    in-memory onto the current ``(data, model)`` mesh first. Template
+    validation still runs on the resharded payload, so genuinely
+    incompatible trees (wrong head width, wrong dtype) keep failing loudly."""
     with np.load(path) as data:
         stored = dict(data.items())
     topo = None
     if _TOPO_MARK in stored:
         topo = json.loads(str(np.asarray(stored[_TOPO_MARK]).item()))
+    cur_model = 1 if model_size is None else int(model_size)
+    file_topo = topo  # the record as WRITTEN — what reshard events report
+    if reshard_on_mismatch and topo is not None and world_size:
+        saved_model = topology_model_size(topo)
+        saved_world = int(topo.get("world_size") or 0)
+        # model-width changes always need the reshaper; at a FIXED model>1
+        # width a data-width change does too (the in-loader elastic path
+        # only redistributes pure-DP residuals). model=1 world changes keep
+        # the pre-existing in-loader elastic path — byte-identical behavior
+        # for every pure-DP caller.
+        if saved_model != cur_model or (
+            cur_model > 1 and saved_world and saved_world != int(world_size)
+        ):
+            from tpuddp.training import reshard as reshard_lib
+
+            stored, topo, racts = reshard_lib.reshard_arrays(
+                stored,
+                data=int(world_size) // cur_model,
+                model=cur_model,
+                path=path,
+            )
+            logger.warning(
+                "elastic reshard: checkpoint %s re-shaped in-memory onto "
+                "(data=%d, model=%d) before load (%d leaf action(s))",
+                path, int(world_size) // cur_model, cur_model, len(racts),
+            )
+            if reshard_actions is not None:
+                reshard_actions.extend(racts)
     _check_model_width(path, topo, model_size)
     flat, treedef = jax.tree_util.tree_flatten_with_path(like)
     leaves = []
@@ -533,17 +573,31 @@ def load_with_topology(
             # the newer template by keeping the template's zero
             # initialization — the exact state a fresh run of that
             # configuration starts from, so resume is correct, just logged.
-            logger.warning(
-                "checkpoint %s predates %s state: leaf %r starts at "
-                "its zero initialization",
-                path,
-                "guard" if "skipped_steps" in key else "comm_hook",
-                key,
+            # A cross-model-width reshard DROPS the residual deliberately
+            # (slices key by model shard); its topology record says so, and
+            # the log names the reset instead of claiming the file is old.
+            dropped = key in ((topo or {}).get("resharded") or {}).get(
+                "dropped", ()
             )
+            if dropped:
+                logger.warning(
+                    "checkpoint %s: leaf %r was reset by a cross-topology "
+                    "reshard (model-width change); it restarts at its zero "
+                    "initialization",
+                    path, key,
+                )
+            else:
+                logger.warning(
+                    "checkpoint %s predates %s state: leaf %r starts at "
+                    "its zero initialization",
+                    path,
+                    "guard" if "skipped_steps" in key else "comm_hook",
+                    key,
+                )
             leaves.append(template)
         else:
             raise KeyError(f"checkpoint {path} is missing leaf {key!r}")
-    return jax.tree_util.tree_unflatten(treedef, leaves), topo
+    return jax.tree_util.tree_unflatten(treedef, leaves), file_topo
 
 
 def load(
@@ -552,6 +606,7 @@ def load(
     world_size: Optional[int] = None,
     reshard_actions: Optional[List[dict]] = None,
     model_size: Optional[int] = None,
+    reshard_on_mismatch: bool = False,
 ) -> Any:
     """Restore a pytree saved by :func:`save`, using ``like`` for structure.
     Leaf shapes and dtypes are validated against ``like``; mismatches raise
@@ -562,10 +617,12 @@ def load(
     is resharded onto the current topology (see the module doc) instead of
     failing. ``world_size`` is the CURRENT world (needed to redistribute
     per-replica leaves); ``model_size`` the current tensor-parallel width
-    (cross-width restores refuse typed); ``reshard_actions`` (a
+    (cross-width restores refuse typed unless ``reshard_on_mismatch`` opts
+    into the cross-topology reshaper); ``reshard_actions`` (a
     caller-supplied list) is appended with one dict per resharded leaf."""
     return load_with_topology(
-        path, like, world_size, reshard_actions, model_size=model_size
+        path, like, world_size, reshard_actions, model_size=model_size,
+        reshard_on_mismatch=reshard_on_mismatch,
     )[0]
 
 
@@ -575,21 +632,26 @@ def build_reshard_events(
     topo: Optional[dict],
     world_size: Optional[int],
     actions: List[dict],
+    model_size: Optional[int] = None,
 ) -> List[dict]:
     """The typed event dicts an elastic restore should land in
-    history.jsonl: one ``topology_change`` summary (worlds, resharded
-    leaves, what happened to the residual) plus one ``comm_state_reset``
-    per residual that had to reset (M∤N). Empty when the restore was
-    same-topology. ONE implementation for every driver — the native epoch
-    driver, the guard-rollback restore, and the managed load_state all
-    record identically."""
+    history.jsonl: one ``topology_change`` summary (worlds, model widths,
+    resharded leaves, what happened to the residual) plus one
+    ``comm_state_reset`` per residual that had to reset. Empty when the
+    restore was same-topology. ONE implementation for every driver — the
+    native epoch driver, the guard-rollback restore, and the managed
+    load_state all record identically."""
     from_world = (topo or {}).get("world_size")
+    from_model = topology_model_size(topo) if topo else None
+    to_model = None if model_size is None else int(model_size)
     if not (actions or (from_world and world_size and from_world != world_size)):
         return []
     events = [{
         "event": "topology_change",
         "from_world": from_world,
         "to_world": world_size,
+        "from_model": from_model,
+        "to_model": to_model,
         "checkpoint": os.path.basename(path),
         "checkpoint_epoch": epoch,
         "resharded_leaves": [a["leaf"] for a in actions],
@@ -604,7 +666,8 @@ def build_reshard_events(
                 "leaf": a["leaf"],
                 "from_world": a["from_world"],
                 "to_world": a["to_world"],
-                "reason": "no divisor relation between world sizes; "
+                "reason": a.get("reason")
+                or "no divisor relation between world sizes; "
                 "error-feedback residual reset to zero",
             })
     logger.warning(
@@ -714,11 +777,46 @@ def latest(save_dir: str, prefix: str = "ckpt") -> Optional[Tuple[str, int]]:
     return None
 
 
+def sweep_stale_tmp(save_dir: str, prefix: str = "ckpt") -> int:
+    """Delete orphaned ``{prefix}_*.npz.tmp`` / ``.sha256.tmp`` staging
+    files. ``save()`` publishes atomically via ``os.replace``, so a writer
+    killed mid-``np.savez`` (preemption, chaos kill) leaks its ``.tmp``
+    forever — never a torn checkpoint, but unbounded junk on long chaotic
+    runs, and a confusing artifact next to the real files. Swept at the two
+    natural janitor points (``restore_latest`` before picking a candidate,
+    ``prune_checkpoints`` after a save) and counted by ``tpuddp_inspect
+    ckpt``'s directory integrity report. Returns the number removed."""
+    if not os.path.isdir(save_dir):
+        return 0
+    pat = re.compile(
+        rf"^{re.escape(prefix)}_\d+\.npz(\.sha256)?\.tmp$"
+    )
+    removed = 0
+    for name in os.listdir(save_dir):
+        if not pat.match(name):
+            continue
+        try:
+            os.remove(os.path.join(save_dir, name))
+            removed += 1
+        except FileNotFoundError:
+            pass
+    if removed:
+        logger.warning(
+            "swept %d stale checkpoint tmp file(s) from %s (writer killed "
+            "mid-save; the atomic publish means no torn checkpoints, only "
+            "orphaned staging files)",
+            removed, save_dir,
+        )
+    return removed
+
+
 def prune_checkpoints(save_dir: str, keep_last: int, prefix: str = "ckpt") -> int:
     """Delete all but the ``keep_last`` newest ``{prefix}_*.npz`` (and their
-    manifests). Returns the number of checkpoints removed."""
+    manifests), plus any stale ``.tmp`` staging orphans. Returns the number
+    of checkpoints removed."""
     if keep_last < 1:
         raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    sweep_stale_tmp(save_dir, prefix)
     removed = 0
     for path, _epoch in _all_checkpoints(save_dir, prefix)[keep_last:]:
         for p in (path, integrity.manifest_path(path)):
@@ -738,6 +836,7 @@ def restore_latest(
     world_size: Optional[int] = None,
     reshard_log: Optional[List[dict]] = None,
     model_size: Optional[int] = None,
+    reshard_on_mismatch: bool = False,
 ) -> Tuple[Any, int]:
     """Load the newest intact checkpoint into ``like``'s structure. Returns
     ``(tree, next_epoch)``; ``(like, 0)`` when none exists. An emergency save
@@ -749,12 +848,14 @@ def restore_latest(
     written on a different world is resharded onto it (see :func:`load`).
     ``model_size`` is the current tensor-parallel width — a checkpoint
     written under a DIFFERENT model width raises the typed
-    :class:`TopologyMismatch` instead of mis-slicing (no model-axis
-    resharding story exists). ``reshard_log`` (a caller-supplied list)
+    :class:`TopologyMismatch` unless ``reshard_on_mismatch`` opts into the
+    cross-topology reshaper (see :func:`load_with_topology`).
+    ``reshard_log`` (a caller-supplied list)
     receives ready-to-write typed event dicts — one ``topology_change``
     summary naming the worlds and the resharded leaves, plus one
     ``comm_state_reset`` per residual that had to reset (M∤N) — so the
     epoch driver can land them as event rows in history.jsonl."""
+    sweep_stale_tmp(save_dir, prefix)
     found = latest(save_dir, prefix)
     if found is None:
         return like, 0
@@ -762,11 +863,13 @@ def restore_latest(
     actions: List[dict] = []
     tree, topo = load_with_topology(
         path, like, world_size=world_size, reshard_actions=actions,
-        model_size=model_size,
+        model_size=model_size, reshard_on_mismatch=reshard_on_mismatch,
     )
     if reshard_log is not None:
         reshard_log.extend(
-            build_reshard_events(path, epoch, topo, world_size, actions)
+            build_reshard_events(
+                path, epoch, topo, world_size, actions, model_size=model_size
+            )
         )
     meta = read_meta(path)
     if not meta.get("completed", 1):

@@ -147,6 +147,7 @@ def run_training_loop(
     scan_steps="auto",
     per_replica_log: bool = False,
     auto_resume: bool = False,
+    reshard_on_mismatch: bool = False,
     keep_last: Optional[int] = None,
     step_stats_every: int = 0,
     run_meta: Optional[dict] = None,
@@ -168,7 +169,12 @@ def run_training_loop(
     polled at batch-group boundaries: the loop writes an emergency checkpoint
     and raises :class:`TrainingPreempted`, which ``spawn.run_ddp_training``
     turns into exit code 75. ``keep_last=K`` prunes all but the K newest
-    checkpoints after each save.
+    checkpoints after each save. ``reshard_on_mismatch=True`` (the
+    ``training.reshard_on_mismatch`` knob) lets the restore re-shape a
+    checkpoint written on a DIFFERENT ``(data, model)`` mesh onto this one
+    via the cross-topology reshaper (training/reshard.py) — the elastic
+    mesh failover path; the reshard lands typed event rows and, when
+    tracing is on, a named ``elastic reshard`` span.
 
     Numerical guard (``ddp.guard``, resilience/guard.py): the wrap owns the
     in-step firewall; this driver owns the epoch policy — it reads the skip
@@ -246,25 +252,9 @@ def run_training_loop(
                     "the host/device cannot hold it",
                     accum, scan_steps * bnb / 1e6, _STAGE_BYTES_BUDGET // 2**20,
                 )
-    # elastic resume (ISSUE 7): restore_latest reshards a checkpoint written
-    # on a different world size onto THIS mesh (training/checkpoint.py) and
-    # hands back the typed topology-change events, written below once the
-    # history's run_meta header exists
-    reshard_log = []
-    if auto_resume or auto_resume_requested():
-        if save_dir is not None:
-            state, resumed = ckpt.restore_latest(
-                save_dir, state,
-                world_size=getattr(ddp, "world_size", None),
-                model_size=getattr(ddp, "model_size", None),
-                reshard_log=reshard_log,
-            )
-            if resumed > start_epoch:
-                start_epoch = resumed
-                if is_main:
-                    log(f"Auto-resume: continuing from epoch {start_epoch}.")
-        elif is_main:
-            log("Auto-resume requested but no save_dir configured; starting fresh.")
+    want_resume = auto_resume or auto_resume_requested()
+    if want_resume and save_dir is None and is_main:
+        log("Auto-resume requested but no save_dir configured; starting fresh.")
 
     history = []
     # ---- live telemetry plane (observability/{exporter,aggregate,flight}):
@@ -285,6 +275,66 @@ def run_training_loop(
             # process died in, not just the last flushed window
             flight.add_context("open_spans", tracer.open_span_summaries)
     metrics_writer = MetricsWriter(save_dir, flight=flight)
+    # the run's ONE trace id: minted before the restore below so an elastic
+    # reshard episode lands as a named span in the SAME trace as the epochs
+    # it precedes — the tracing plane shows recovery, not a gap
+    run_trace_id = tracer.new_trace()
+    # elastic resume (ISSUE 7 / ISSUE 16): restore_latest reshards a
+    # checkpoint written on a different world size — and, with
+    # reshard_on_mismatch, a different (data, model) MESH SHAPE — onto this
+    # one (training/checkpoint.py + training/reshard.py) and hands back the
+    # typed topology-change events, written below once the history's
+    # run_meta header exists.
+    reshard_log = []
+    if want_resume and save_dir is not None:
+        resume_span = tracer.start_span(
+            "auto-resume restore", trace_lib.KIND_ACTION,
+            trace_id=run_trace_id, tid="train",
+        )
+        state, resumed = ckpt.restore_latest(
+            save_dir, state,
+            world_size=getattr(ddp, "world_size", None),
+            model_size=getattr(ddp, "model_size", None),
+            reshard_log=reshard_log,
+            reshard_on_mismatch=reshard_on_mismatch,
+        )
+        if resumed > start_epoch:
+            start_epoch = resumed
+            if is_main:
+                log(f"Auto-resume: continuing from epoch {start_epoch}.")
+        topo_ev = next(
+            (ev for ev in reshard_log if ev.get("event") == "topology_change"),
+            None,
+        )
+        if topo_ev is not None:
+            # name the reshard episode on every observability surface: a
+            # child span in the run trace, a flight-recorder note, and (just
+            # below) the typed history event rows
+            reshard_span = tracer.start_span(
+                "elastic reshard", trace_lib.KIND_ACTION,
+                parent=resume_span,
+                attrs={k: topo_ev.get(k) for k in (
+                    "from_world", "to_world", "from_model", "to_model",
+                    "checkpoint", "residual",
+                )},
+            )
+            tracer.end_span(
+                reshard_span, resharded_leaves=len(topo_ev.get(
+                    "resharded_leaves") or ()),
+            )
+            if flight is not None:
+                # namespaced note key: any later crash dump carries the
+                # episode under notes["elastic_reshard"]
+                flight.note(elastic_reshard={
+                    k: topo_ev.get(k) for k in (
+                        "from_world", "to_world", "from_model", "to_model",
+                        "checkpoint",
+                    )
+                })
+        tracer.end_span(
+            resume_span, resumed_epoch=start_epoch,
+            resharded=bool(reshard_log),
+        )
     # gradient-comm wire-bytes accounting (parallel/comm.py counter): one
     # optimizer update per accumulation cycle; the payload per update is
     # static, so the counter is free host arithmetic next to the device step
@@ -331,8 +381,11 @@ def run_training_loop(
     )
     if topo_change is not None:
         # the header states the elastic provenance: this run CONTINUES a
-        # trajectory that was training on a different world size
+        # trajectory that was training on a different world size (and,
+        # after a mesh failover, a different model width)
         meta_extra["resumed_from_world"] = topo_change.get("from_world")
+        if topo_change.get("from_model") is not None:
+            meta_extra["resumed_from_model"] = topo_change.get("from_model")
     # exporter starts BEFORE the header so the header can record the BOUND
     # port (ephemeral binds resolve at start); sources attach once the
     # telemetry bundle exists below
@@ -443,6 +496,7 @@ def run_training_loop(
             world_size=getattr(ddp, "world_size", None),
             model_size=getattr(ddp, "model_size", None),
             reshard_log=rb_log,
+            reshard_on_mismatch=reshard_on_mismatch,
         )
         metrics_writer.write(stamp("event", {
             "event": "rollback",
@@ -537,10 +591,10 @@ def run_training_loop(
         )
 
     # the whole run is ONE trace: every epoch span (and its stage/dispatch/
-    # collective/readback children) shares this id. The comm annotation only
-    # arms on the train pass of a hooked run — eval dispatches carry no
-    # gradient exchange.
-    run_trace_id = tracer.new_trace()
+    # collective/readback children) shares run_trace_id, minted above before
+    # the auto-resume restore so a reshard episode rides the same tree. The
+    # comm annotation only arms on the train pass of a hooked run — eval
+    # dispatches carry no gradient exchange.
     epoch_span = None
     comm_attrs = None
     if tracer.enabled and getattr(ddp, "comm_hook", "none") != "none":
@@ -642,6 +696,38 @@ def run_training_loop(
                 tracer=tracer, trace_parent=epoch_span,
             )
             if interrupted:
+                # The train pass landed every optimizer update of this epoch
+                # (that is what completed=True means), so the epoch row must
+                # land too: resume starts at epoch + 1 and never rewrites it,
+                # and a drain that raced the eval pass would otherwise leave a
+                # permanent hole in history.jsonl. Eval metrics are honestly
+                # NaN — same shape as the empty-test-loader row.
+                if train_acc is not None:
+                    tm = finalize_metrics({"train": train_acc})["train"]
+                    epoch_time = time.perf_counter() - t0
+                    epoch_updates = -(-len(train_loader) // accum)
+                    comm_counter.add_updates(epoch_updates)
+                    record = {
+                        "epoch": epoch,
+                        "train_loss": tm["loss_sum"] / max(tm["n"], 1.0),
+                        "test_loss": float("nan"),
+                        "test_accuracy": float("nan"),
+                        "train_samples": tm["n"],
+                        "test_samples": 0.0,
+                        "epoch_time_s": epoch_time,
+                        "samples_per_sec": tm["n"] / max(epoch_time, 1e-9),
+                    }
+                    record.update(tel.end_epoch())
+                    record.update(comm_counter.snapshot(epoch_updates))
+                    if guard_cfg.enabled:
+                        total_skips, _ = guard_lib.read_skip_counters(state)
+                        record["skipped_steps"] = total_skips
+                        record["skipped_steps_epoch"] = (
+                            total_skips - prev_total_skips
+                        )
+                    record = stamp("epoch", record)
+                    history.append(record)
+                    metrics_writer.write(record)
                 emergency_stop(epoch, completed=True)
 
             if train_acc is None:

@@ -190,6 +190,9 @@ def basic_ddp_training_loop(
         # $TPUDDP_AUTO_RESUME=1, the scheduler-requeue contract);
         # keep_last bounds checkpoint disk on long runs
         auto_resume=bool(training.get("auto_resume") or training.get("resume")),
+        # elastic mesh failover: opt into re-shaping a checkpoint written on
+        # a different (data, model) mesh at restore (training/reshard.py)
+        reshard_on_mismatch=bool(training.get("reshard_on_mismatch")),
         keep_last=(
             int(training["keep_last"]) if training.get("keep_last") else None
         ),
