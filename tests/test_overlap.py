@@ -1,0 +1,531 @@
+"""Segmented backward/collective overlap (``comm_overlap``, training/step.py
++ parallel/comm.py::make_segments) — torch DDP's ready-bucket overlap rebuilt
+inside the compiled step.
+
+Pinned contracts:
+
+- segment derivation: boundaries are exactly the layer boundaries that
+  coincide with bucket edges; buckets are never split; zero-param children
+  attach to the neighboring segment; the tail segment absorbs the padding;
+- bitwise parity: overlap-on and overlap-off produce bit-identical loss
+  trajectories, params, and comm_state for EVERY hook (none/bf16_ef/
+  int8_ef/topk_ef), with and without grad accumulation, and under the guard;
+- byte accounting: segmentation can never change (or double-count) the wire
+  bytes — the per-segment payload sums to the barrier-mode counter exactly,
+  scales/indices included (satellite: CommBytesCounter/comm_bytes_breakdown
+  formula pin);
+- guard firewall: a poisoned step is a no-op over EVERY segment's residual
+  slice, not just the whole vector;
+- eligibility: ``auto`` falls back to the barrier builder with a recorded
+  reason wherever genuine segmentation is impossible (auto mode, WUS,
+  hierarchical, model axis, non-Sequential, single segment); ``true``
+  refuses loudly on the same matrix;
+- checkpoints: a segmented run's comm_state restores bitwise into a
+  barrier-mode run (and back), and rides the elastic 4 -> 2 redistribution
+  unchanged;
+- HLO: the overlap-on step's lowered program holds K > 1 collectives with
+  backward compute between them; barrier mode keeps one trailing block
+  (comm.hlo_overlap_evidence — the same detector bench.py and the gate use).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuddp import nn, optim
+from tpuddp.data import SyntheticClassification
+from tpuddp.models import ToyMLP
+from tpuddp.observability.metrics import CommBytesCounter
+from tpuddp.parallel import comm as comm_lib
+from tpuddp.parallel import make_mesh
+from tpuddp.parallel.ddp import DistributedDataParallel
+from tpuddp.training import checkpoint as ckpt
+from tpuddp.training.step import stack_batches
+
+KEY = jax.random.key(0)
+MB = 1024 * 1024
+HOOKS = ("none", "bf16_ef", "int8_ef", "topk_ef")
+
+
+def cap_mb(elems: int) -> float:
+    """bucket_cap_mb holding exactly ``elems`` f32 elements."""
+    return elems * 4 / MB
+
+
+def make_batch(n=64, seed=5, shape=(8, 8, 3)):
+    ds = SyntheticClassification(n=n, shape=shape, seed=seed)
+    x, y = ds.get_batch(np.arange(n))
+    return x, y, np.ones(n, np.float32)
+
+
+# ToyMLP(hidden=(16,)) on 8x8x3 inputs: Flatten -> Linear(192,16) -> ReLU ->
+# Linear(16,10). A 600-element cap splits the two Linears into separate
+# buckets, so the segmented step genuinely gets K=2.
+SPLIT_CAP = cap_mb(600)
+
+
+def build(cpu_devices, overlap, hook="bf16_ef", world=8, cap=SPLIT_CAP, **kw):
+    if kw.get("comm_topology") == "hierarchical":
+        from tpuddp.parallel.mesh import hierarchical_mesh
+
+        mesh = hierarchical_mesh(devices=cpu_devices[:world])
+    else:
+        mesh = make_mesh(cpu_devices[:world])
+    return DistributedDataParallel(
+        ToyMLP(hidden=(16,)),
+        optim.Adam(1e-2),
+        nn.CrossEntropyLoss(),
+        mesh=mesh,
+        comm_hook=hook,
+        bucket_cap_mb=cap,
+        comm_overlap=overlap,
+        **kw,
+    )
+
+
+def run_steps(ddp, steps=4, accum=1, batches=None):
+    """Train ``steps`` updates; returns (meta, losses, state)."""
+    x, y, w = make_batch()
+    state = ddp.init_state(KEY, x[:8])
+    losses = []
+    for i in range(steps):
+        xb, yb, wb = batches[i] if batches else make_batch(seed=100 + i)
+        if accum == 1:
+            state, m = ddp.train_step(state, ddp.shard((xb, yb, wb)))
+        else:
+            half = len(xb) // accum
+            micros = [
+                (xb[j * half:(j + 1) * half], yb[j * half:(j + 1) * half],
+                 wb[j * half:(j + 1) * half])
+                for j in range(accum)
+            ]
+            state, m = ddp.train_step_many(
+                state, ddp.shard_stacked(stack_batches(micros))
+            )
+        m = jax.device_get(m)
+        losses.append(float(np.sum(m["loss_sum"]) / np.sum(m["n"])))
+    return ddp.comm_overlap_meta, losses, state
+
+
+def assert_states_equal(a, b):
+    for pa, pb in zip(
+        jax.tree_util.tree_leaves(a.params), jax.tree_util.tree_leaves(b.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+    if a.comm_state is not None or b.comm_state is not None:
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a.comm_state)),
+            np.asarray(jax.device_get(b.comm_state)),
+        )
+
+
+# ------------------------------------------------------- make_segments -----
+
+
+def test_segments_follow_bucket_aligned_layer_boundaries():
+    # layers of 6/6/6 elements, buckets of 12+12: only the 12 boundary is
+    # both a layer edge and a bucket edge -> two segments of (2, 1) layers
+    buckets = comm_lib.make_buckets((6, 6, 6), total=24, bucket_cap_mb=cap_mb(12))
+    segs = comm_lib.make_segments((6, 6, 6), buckets, 24)
+    assert [s.flat for s in segs] == [(0, 12), (12, 24)]
+    assert [s.layers for s in segs] == [(0, 2), (2, 3)]
+    assert [s.buckets for s in segs] == [((0, 12),), ((12, 24),)]
+
+
+def test_segments_never_split_a_bucket():
+    # one bucket straddles the layer-1/layer-2 boundary: those layers fuse
+    buckets = ((0, 10), (10, 24))
+    segs = comm_lib.make_segments((6, 6, 12), buckets, 24)
+    assert len(segs) == 1  # no layer edge lands on a bucket edge
+    assert segs[0].flat == (0, 24)
+    assert segs[0].layers == (0, 3)
+    assert segs[0].buckets == buckets
+
+
+def test_segments_zero_param_children_attach():
+    # Flatten(0) Linear(8) ReLU(0) Linear(8): zero-param children never
+    # create zero-width segments; trailing ones attach to the last segment
+    buckets = comm_lib.make_buckets((0, 8, 0, 8), total=16, bucket_cap_mb=cap_mb(8))
+    segs = comm_lib.make_segments((0, 8, 0, 8), buckets, 16)
+    assert [s.flat for s in segs] == [(0, 8), (8, 16)]
+    assert segs[0].layers == (0, 3) or segs[0].layers == (0, 2)
+    assert segs[-1].layers[1] == 4  # trailing children covered
+    # every child belongs to exactly one segment, in order
+    covered = [s.layers for s in segs]
+    assert covered[0][0] == 0 and covered[-1][1] == 4
+    for (a0, a1), (b0, b1) in zip(covered, covered[1:]):
+        assert a1 == b0
+
+
+def test_segments_tail_absorbs_padding():
+    # raw 12 elements padded to 16: the padding rides the last segment, and
+    # the segments tile [0, total) exactly like the buckets do
+    buckets = comm_lib.make_buckets((6, 6), total=16, bucket_cap_mb=cap_mb(6))
+    segs = comm_lib.make_segments((6, 6), buckets, 16)
+    assert segs[-1].flat[1] == 16
+    assert segs[0].flat[0] == 0
+    for a, b in zip(segs, segs[1:]):
+        assert a.flat[1] == b.flat[0]
+    assert sum(len(s.buckets) for s in segs) == len(buckets)
+
+
+def test_segments_single_bucket_is_single_segment():
+    buckets = ((0, 24),)
+    segs = comm_lib.make_segments((6, 6, 6), buckets, 24)
+    assert len(segs) == 1
+    assert segs[0] == comm_lib.CommSegment((0, 3), (0, 24), ((0, 24),))
+
+
+def test_segments_refuse_inconsistent_totals():
+    with pytest.raises(ValueError, match="layer sizes"):
+        comm_lib.make_segments((30,), ((0, 24),), 24)
+
+
+# ------------------------------------------------------ bitwise parity -----
+
+
+@pytest.mark.parametrize("hook", HOOKS)
+def test_overlap_bitwise_parity_per_hook(cpu_devices, hook):
+    m_on, l_on, s_on = run_steps(build(cpu_devices, True, hook=hook))
+    m_off, l_off, s_off = run_steps(build(cpu_devices, False, hook=hook))
+    assert m_on["enabled"] and m_on["segments"] > 1, m_on
+    assert m_off == {"enabled": False, "segments": None, "reason": "disabled"}
+    assert l_on == l_off  # bitwise loss trajectory
+    assert_states_equal(s_on, s_off)
+
+
+@pytest.mark.parametrize("hook", ["none", "bf16_ef"])
+def test_overlap_bitwise_parity_under_grad_accumulation(cpu_devices, hook):
+    m_on, l_on, s_on = run_steps(
+        build(cpu_devices, True, hook=hook, grad_accumulation=2), accum=2
+    )
+    _, l_off, s_off = run_steps(
+        build(cpu_devices, False, hook=hook, grad_accumulation=2), accum=2
+    )
+    assert m_on["enabled"], m_on
+    assert l_on == l_off
+    assert_states_equal(s_on, s_off)
+
+
+def test_overlap_bitwise_parity_with_guard(cpu_devices):
+    _, l_on, s_on = run_steps(build(cpu_devices, True, guard=True))
+    _, l_off, s_off = run_steps(build(cpu_devices, False, guard=True))
+    assert l_on == l_off
+    assert_states_equal(s_on, s_off)
+    from tpuddp.resilience import guard as guard_lib
+
+    assert guard_lib.read_skip_counters(s_on) == (0, 0)
+
+
+def test_auto_equals_explicit_true_when_eligible(cpu_devices):
+    m_auto, l_auto, s_auto = run_steps(build(cpu_devices, "auto"))
+    m_true, l_true, s_true = run_steps(build(cpu_devices, True))
+    assert m_auto == m_true and m_auto["enabled"]
+    assert l_auto == l_true
+    assert_states_equal(s_auto, s_true)
+
+
+# ------------------------------------------------- guard segment no-op -----
+
+
+def test_guard_skip_is_noop_across_all_segment_residual_slices(cpu_devices):
+    ddp = build(cpu_devices, True, hook="bf16_ef", guard=True)
+    x, y, w = make_batch()
+    state = ddp.init_state(KEY, x[:8])
+    # warm up one clean step so the residual is nonzero in every segment
+    state, _ = ddp.train_step(state, ddp.shard((x, y, w)))
+    before = np.asarray(jax.device_get(state.comm_state))
+    spec_total = ddp._comm.spec.total
+    for seg in ddp._segments:
+        lo, hi = seg.flat
+        per = before.reshape(ddp.world_size, spec_total)[:, lo:hi]
+        assert np.abs(per).sum() > 0, f"segment {seg} residual never armed"
+    params_before = jax.device_get(state.params)
+    xb = x.copy()
+    xb[:] = np.nan  # poison EVERY segment's gradient
+    state, _ = ddp.train_step(state, ddp.shard((xb, y, w)))
+    after = np.asarray(jax.device_get(state.comm_state))
+    # the skip must be a no-op over every segment's residual slice: a
+    # half-updated residual would silently corrupt error feedback
+    np.testing.assert_array_equal(after, before)
+    for pa, pb in zip(
+        jax.tree_util.tree_leaves(jax.device_get(state.params)),
+        jax.tree_util.tree_leaves(params_before),
+    ):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb))
+    from tpuddp.resilience import guard as guard_lib
+
+    assert guard_lib.read_skip_counters(state) == (1, 1)
+
+
+# --------------------------------------------------- eligibility matrix ----
+
+
+@pytest.mark.parametrize(
+    "kw,reason_frag",
+    [
+        (dict(mode="auto"), "auto"),
+        (dict(weight_update_sharding=True), "weight_update_sharding"),
+        (dict(comm_topology="hierarchical", hook="bf16_ef"), "hierarchical"),
+        (dict(remat=True), "remat"),
+    ],
+)
+def test_auto_falls_back_with_reason(cpu_devices, kw, reason_frag):
+    hook = kw.pop("hook", "none")
+    ddp = build(cpu_devices, "auto", hook=hook, **kw)
+    x, _, _ = make_batch()
+    ddp.init_state(KEY, x[:8])
+    meta = ddp.comm_overlap_meta
+    assert meta["enabled"] is False
+    assert meta["segments"] is None
+    assert reason_frag in meta["reason"]
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(mode="auto"),
+        dict(weight_update_sharding=True),
+        dict(comm_topology="hierarchical", hook="bf16_ef"),
+        dict(remat=True),
+    ],
+)
+def test_true_refuses_ineligible(cpu_devices, kw):
+    hook = kw.pop("hook", "none")
+    ddp = build(cpu_devices, True, hook=hook, **kw)
+    x, _, _ = make_batch()
+    with pytest.raises(ValueError, match="comm_overlap"):
+        ddp.init_state(KEY, x[:8])
+
+
+def test_auto_single_segment_falls_back(cpu_devices):
+    # the 25 MB default cap puts the whole ToyMLP in one bucket -> one
+    # segment -> auto quietly keeps the barrier builder (the default-config
+    # guarantee: existing runs see a byte-identical step program)
+    ddp = build(cpu_devices, "auto", cap=None and SPLIT_CAP or 25.0)
+    x, _, _ = make_batch()
+    ddp.init_state(KEY, x[:8])
+    meta = ddp.comm_overlap_meta
+    assert meta["enabled"] is False
+    assert "single" in meta["reason"]
+
+
+def test_true_allows_single_segment(cpu_devices):
+    # explicit true with one segment is legal (a degenerate but honest K=1)
+    ddp = build(cpu_devices, True, cap=25.0)
+    _, losses, _ = run_steps(ddp, steps=2)
+    assert ddp.comm_overlap_meta == {
+        "enabled": True, "segments": 1, "reason": None,
+    }
+    assert all(np.isfinite(v) for v in losses)
+
+
+def test_wus_fallback_parity(cpu_devices):
+    # ISSUE's "incl. WUS" parity: auto on a WUS wrap falls back to the exact
+    # barrier builder, so it is bitwise the comm_overlap=false run
+    kw = dict(weight_update_sharding=True, hook="bf16_ef")
+    _, l_auto, s_auto = run_steps(build(cpu_devices, "auto", **kw))
+    _, l_off, s_off = run_steps(build(cpu_devices, False, **kw))
+    assert l_auto == l_off
+    assert_states_equal(s_auto, s_off)
+
+
+def test_hierarchical_fallback_parity(cpu_devices):
+    kw = dict(comm_topology="hierarchical", hook="int8_ef")
+    _, l_auto, s_auto = run_steps(build(cpu_devices, "auto", **kw))
+    _, l_off, s_off = run_steps(build(cpu_devices, False, **kw))
+    assert l_auto == l_off
+    assert_states_equal(s_auto, s_off)
+
+
+def test_accelerator_refuses_true_and_records_reason(tmp_path):
+    from tpuddp.accelerate import Accelerator
+
+    with pytest.raises(ValueError, match="comm_overlap"):
+        Accelerator(comm_overlap=True)
+    acc = Accelerator(comm_overlap="auto")
+    meta = acc.comm_overlap_meta
+    assert meta["enabled"] is False and meta["reason"]
+    acc2 = Accelerator(comm_overlap=False)
+    assert acc2.comm_overlap_meta["reason"] == "disabled"
+
+
+def test_bad_knob_value_refused(cpu_devices):
+    with pytest.raises(ValueError, match="comm_overlap"):
+        build(cpu_devices, "always")
+
+
+# ------------------------------------------------- byte accounting pin -----
+
+
+@pytest.mark.parametrize("hook", HOOKS)
+def test_comm_bytes_identical_segmented_vs_barrier(cpu_devices, hook):
+    """Satellite pin: segmentation reorders WHEN buckets go on the wire, not
+    what they carry — per-step bytes, the f32 baseline, the hop breakdown,
+    and the cumulative counter must be equal in both modes, and the
+    segmented total must equal the sum of the per-segment bucket payloads
+    (scales + indices included), so a per-segment re-derivation can never
+    double-count the side-channel bytes."""
+    ddp_on = build(cpu_devices, True, hook=hook)
+    ddp_off = build(cpu_devices, False, hook=hook)
+    x, _, _ = make_batch()
+    ddp_on.init_state(KEY, x[:8])
+    ddp_off.init_state(KEY, x[:8])
+    assert ddp_on.grad_comm_bytes_per_step == ddp_off.grad_comm_bytes_per_step
+    assert (
+        ddp_on.grad_comm_bytes_per_step_f32
+        == ddp_off.grad_comm_bytes_per_step_f32
+    )
+    assert ddp_on._grad_comm_breakdown == ddp_off._grad_comm_breakdown
+    if hook != "none":
+        # formula: the barrier counter is a sum over buckets; the segments
+        # partition the buckets, so the double sum is the same number
+        per_segment = sum(
+            comm_lib._bucket_payload_bytes(hook, e - s, ddp_on._comm.density)
+            for seg in ddp_on._segments
+            for s, e in seg.buckets
+        )
+        assert per_segment == ddp_on.grad_comm_bytes_per_step
+    # the running counter sees identical per-update payloads -> identical
+    # totals after any number of updates
+    c_on = CommBytesCounter(ddp_on.grad_comm_bytes_per_step)
+    c_off = CommBytesCounter(ddp_off.grad_comm_bytes_per_step)
+    c_on.add_updates(17)
+    c_off.add_updates(17)
+    assert c_on.snapshot(5) == c_off.snapshot(5)
+
+
+# --------------------------------------------------------- checkpoints -----
+
+
+def test_segmented_checkpoint_resumes_into_barrier_and_back(
+    cpu_devices, tmp_path
+):
+    """comm_state is mode-agnostic state: 3 segmented steps + save + restore
+    into a barrier wrap + 3 barrier steps == 6 barrier steps, bitwise (and
+    the mirror-image order too)."""
+    batches = [make_batch(seed=100 + i) for i in range(6)]
+    _, _, ref = run_steps(
+        build(cpu_devices, False), steps=6, batches=batches
+    )
+
+    def cross(first_overlap, second_overlap):
+        ddp_a = build(cpu_devices, first_overlap)
+        _, _, s3 = run_steps(ddp_a, steps=3, batches=batches)
+        ckpt.save_on_main(str(tmp_path), 1, s3, world_size=8)
+        ddp_b = build(cpu_devices, second_overlap)
+        x, _, _ = make_batch()
+        fresh = ddp_b.init_state(KEY, x[:8])
+        restored, _ = ckpt.restore_latest(str(tmp_path), fresh, world_size=8)
+        state = dataclasses.replace(restored, rng=s3.rng)
+        for i in range(3, 6):
+            xb, yb, wb = batches[i]
+            state, _ = ddp_b.train_step(state, ddp_b.shard((xb, yb, wb)))
+        return state
+
+    assert_states_equal(cross(True, False), ref)
+    assert_states_equal(cross(False, True), ref)
+
+
+def test_segmented_elastic_shrink_4_to_2(cpu_devices, tmp_path):
+    """A segmented run's residual rides the elastic 4 -> 2 redistribution
+    exactly as a barrier run's (per-replica rows summed in groups), and the
+    halved world trains on segmented."""
+    ddp4 = build(cpu_devices, True, world=4)
+    _, _, s4 = run_steps(ddp4, steps=2)
+    assert ddp4.comm_overlap_meta["enabled"]
+    mat4 = np.asarray(jax.device_get(s4.comm_state)).reshape(
+        4, ddp4._comm.spec.total
+    )
+    assert np.abs(mat4).sum() > 0
+    ckpt.save_on_main(str(tmp_path), 1, s4, world_size=4)
+
+    ddp2 = build(cpu_devices, True, world=2)
+    x, _, _ = make_batch()
+    fresh = ddp2.init_state(jax.random.key(7), x[:8])
+    log = []
+    restored, _ = ckpt.restore_latest(
+        str(tmp_path), fresh, world_size=2, reshard_log=log
+    )
+    per2 = ddp2._comm.spec.total
+    got = np.asarray(jax.device_get(restored.comm_state)).reshape(2, per2)
+    np.testing.assert_array_equal(
+        got, mat4[:, :per2].reshape(2, 2, per2).sum(axis=1)
+    )
+    ev = [e for e in log if e["event"] == "topology_change"]
+    assert ev and ev[0]["from_world"] == 4 and ev[0]["to_world"] == 2
+    xb, yb, wb = make_batch(seed=9)
+    st, m = ddp2.train_step(restored, ddp2.shard((xb, yb, wb)))
+    assert np.isfinite(float(np.sum(np.asarray(m["loss_sum"]))))
+
+
+# ----------------------------------------------------- HLO interleaving ----
+
+
+def lowered_text(ddp):
+    x, y, w = make_batch()
+    state = ddp.init_state(KEY, x[:8])
+    batch = ddp.shard((x, y, w))
+    ddp.train_step(state, batch)  # builds + caches the step
+    xs, ys, ws = batch
+    return ddp._train_step.jitted.lower(state, xs, ys, ws).as_text()
+
+
+@pytest.mark.parametrize("hook", ["none", "bf16_ef"])
+def test_hlo_shows_interleaved_collectives(cpu_devices, hook):
+    ev_on = comm_lib.hlo_overlap_evidence(
+        lowered_text(build(cpu_devices, True, hook=hook))
+    )
+    ev_off = comm_lib.hlo_overlap_evidence(
+        lowered_text(build(cpu_devices, False, hook=hook))
+    )
+    # overlap-on: K >= 2 collectives with backward compute strictly between
+    # the first and last issue — the program XLA gets genuinely allows the
+    # reductions to run while later (earlier-layer) backward compute proceeds
+    assert len(ev_on["collective_lines"]) >= 2, ev_on
+    assert ev_on["interleaved"], ev_on
+    assert len(ev_on["interleaved_compute"]) > 0
+    # barrier mode: whatever collectives exist form one trailing block
+    assert not ev_off["interleaved"], ev_off
+
+
+def test_hlo_overlap_evidence_is_pure_text():
+    txt = "\n".join([
+        "%dot_general.1 = f32[4,4] dot_general(...)",
+        '%all-reduce.1 = f32[8] all-reduce(...)',
+        "%dot_general.2 = f32[4,4] dot_general(...)",
+        '%all-reduce.2 = f32[8] all-reduce(...)',
+    ])
+    ev = comm_lib.hlo_overlap_evidence(txt)
+    assert ev == {
+        "collective_lines": [1, 3], "compute_lines": [0, 2],
+        "interleaved_compute": [2], "interleaved": True,
+    }
+    ev2 = comm_lib.hlo_overlap_evidence("%dot_general.1 ...\n%all-reduce.1 ...")
+    assert not ev2["interleaved"]
+
+
+# ------------------------------------------------------ run provenance -----
+
+
+def test_run_meta_carries_overlap_provenance():
+    from tpuddp.observability import schema
+
+    rec = schema.make_run_meta(
+        world_size=8,
+        comm={"overlap": {"enabled": True, "segments": 3, "reason": None}},
+    )
+    assert rec["comm"]["overlap"]["segments"] == 3
+    assert schema.validate_record(rec) == []
+    # drift rejection: a v10 header whose comm block lacks the overlap
+    # member is invalid (and a non-dict comm likewise)
+    bad = dict(rec, comm={"something": 1})
+    assert schema.validate_record(bad)
+    worse = dict(rec, comm=7)
+    assert schema.validate_record(worse)
+    # meshless/serving headers carry null comm — legal
+    rec_null = schema.make_run_meta(world_size=1, comm=None)
+    assert schema.validate_record(rec_null) == []

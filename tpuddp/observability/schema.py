@@ -64,7 +64,15 @@ reader must distinguish "no spans because tracing was off" from
 and drop accounting plus the slowest-span table, written once at drain
 by every traced writer), and the ``trace_<role>.json`` sidecar artifact
 (a Chrome-trace-event file with a ``tpuddp`` provenance block,
-:func:`validate_trace_payload` — loadable in Perfetto as-is).
+:func:`validate_trace_payload` — loadable in Perfetto as-is);
+v10 added the required run_meta ``comm`` block (the gradient-exchange
+execution provenance, training/step.py ``comm_overlap``): its
+``overlap`` member records whether the step ran segmented-backward
+({enabled, segments} — the bucket-aligned backward segments whose
+collectives interleave with backward compute) or the barrier step and
+why. Null for writers with no gradient exchange (serving headers), but
+the KEY must exist — a reader must distinguish "barrier because overlap
+resolved off" from "predates the overlap mode".
 Readers accept every version up to their own ``SCHEMA_VERSION`` and
 reject newer files; the per-version required-field sets apply at the
 version each record CARRIES, so a v2 history (no occupancy fields) stays
@@ -78,7 +86,7 @@ import hashlib
 import json
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 
 RECORD_TYPES = (
     "run_meta", "epoch", "step_stats", "event", "serving_stats",
@@ -230,6 +238,16 @@ _REQUIRED_SINCE = {
     9: {
         "run_meta": ("tracing",),
     },
+    # v10: the gradient-exchange execution provenance (``comm_overlap``,
+    # training/step.py). Null for writers with no gradient exchange (serving
+    # headers) but the KEY must exist: a reader needs to distinguish
+    # "barrier step because overlap resolved off (and why)" from "this
+    # header predates segmented-backward execution". An enabled block's
+    # ``overlap.segments`` counts the bucket-aligned backward segments whose
+    # collectives interleave with backward compute.
+    10: {
+        "run_meta": ("comm",),
+    },
 }
 
 def stamp(record_type: str, record: dict) -> dict:
@@ -264,6 +282,7 @@ def make_run_meta(
     survivability: Optional[dict] = None,
     tp_rules_hash: Optional[str] = None,
     tracing: Optional[dict] = None,
+    comm: Optional[dict] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Build the run_meta header row from live run objects.
@@ -338,6 +357,11 @@ def make_run_meta(
         # required since schema v9: the causal tracing plane's provenance
         # (ring capacity + artifact name; null = tracing off, the default)
         "tracing": tracing,
+        # required since schema v10: the gradient-exchange execution
+        # provenance — comm.overlap records whether the step ran
+        # segmented-backward ({enabled, segments}) or the barrier step and
+        # why (null = no gradient exchange, e.g. serving headers)
+        "comm": comm,
     }
     if extra:
         record.update(extra)
@@ -378,6 +402,15 @@ def validate_record(record, index: int = 0) -> List[str]:
         shape = record.get("mesh_shape")
         if shape is not None and not isinstance(shape, dict):
             errors.append(f"{where} (run_meta): mesh_shape must be an object or null")
+        if isinstance(version, int) and version >= 10 and "comm" in record:
+            comm = record.get("comm")
+            if comm is not None and (
+                not isinstance(comm, dict) or "overlap" not in comm
+            ):
+                errors.append(
+                    f"{where} (run_meta): comm must be null or an object "
+                    "with an 'overlap' member"
+                )
     return errors
 
 

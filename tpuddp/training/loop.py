@@ -403,6 +403,13 @@ def run_training_loop(
             flight.describe() if flight is not None else False
         ),
     }
+    # v10 comm block: the gradient-exchange execution provenance — did the
+    # step run segmented-backward (comm_overlap) and over how many segments,
+    # or the barrier step and why (null on wraps predating the knob)
+    overlap_meta = getattr(ddp, "comm_overlap_meta", None)
+    comm_block = (
+        {"overlap": dict(overlap_meta)} if overlap_meta is not None else None
+    )
     metrics_writer.write(make_run_meta(
         mesh=getattr(ddp, "mesh", None),
         world_size=getattr(ddp, "world_size", None),
@@ -415,6 +422,7 @@ def run_training_loop(
         tp_rules_hash=getattr(ddp, "tp_rules_hash", None),
         # v9 tracing block: ring capacity + artifact name (null = off)
         tracing=tracer.describe(),
+        comm=comm_block,
         extra=meta_extra,
     ))
     for ev in reshard_log:
@@ -597,9 +605,12 @@ def run_training_loop(
     # dispatches carry no gradient exchange.
     epoch_span = None
     comm_attrs = None
-    if tracer.enabled and getattr(ddp, "comm_hook", "none") != "none":
+    _overlap_on = bool((overlap_meta or {}).get("enabled"))
+    if tracer.enabled and (
+        getattr(ddp, "comm_hook", "none") != "none" or _overlap_on
+    ):
         comm_attrs = {
-            "hook": ddp.comm_hook,
+            "hook": getattr(ddp, "comm_hook", "none"),
             "topology": getattr(ddp, "comm_topology", "flat"),
             "wire_bytes_per_update": getattr(
                 ddp, "grad_comm_bytes_per_step", None
@@ -611,6 +622,21 @@ def run_training_loop(
                 ddp, "grad_comm_bytes_inter_host", None
             ),
         }
+        if _overlap_on:
+            # segmented-backward overlap: one collective span per backward
+            # segment (pipeline.run_pass fans these out), each naming its
+            # layer range and bucket count so trace_breakdown.py can show
+            # the interleaving visually
+            comm_attrs["overlap"] = True
+            comm_attrs["segments"] = [
+                {
+                    "segment": i,
+                    "layers": list(seg.layers),
+                    "flat": list(seg.flat),
+                    "buckets": len(seg.buckets),
+                }
+                for i, seg in enumerate(getattr(ddp, "_segments", ()) or ())
+            ]
 
     try:
         epoch = start_epoch

@@ -312,12 +312,16 @@ def run_training_loop(
             flight.describe() if flight is not None else False
         ),
     }
+    # v10 comm block: the managed path always runs the barrier exchange
+    # (XLA-inserted psum); the header records that resolution explicitly
+    _overlap = getattr(accelerator, "comm_overlap_meta", None)
     metrics_writer.write(make_run_meta(
         mesh=getattr(accelerator, "mesh", None),
         comm_hook=getattr(accelerator, "comm_hook", None),
         comm_topology=getattr(accelerator, "comm_topology", "flat"),
         guard=guard_cfg,
         observability=obs_meta,
+        comm={"overlap": dict(_overlap)} if _overlap is not None else None,
         extra=meta_extra,
     ))
     for ev in restore_events:
@@ -668,6 +672,9 @@ def basic_accelerate_training(
         comm_hook=str(training.get("comm_hook") or "none"),
         bucket_cap_mb=float(training.get("bucket_cap_mb") or 25),
         comm_topology=str(training.get("comm_topology") or "flat"),
+        # comm_overlap parity: "auto"/false record disabled provenance here
+        # (the managed collective is XLA-inserted); true refuses loudly
+        comm_overlap=training.get("comm_overlap", "auto"),
         topk_density=float(training.get("topk_density") or 0.1),
         # numerical guard (resilience/guard.py): non-finite-update firewall
         # in the fused/scan/accumulation programs + prepare-time desync audit
