@@ -876,3 +876,154 @@ def test_hang_at_barrier_detected_by_watchdog(tmp_path):
         hanger.kill()
         hanger.communicate(timeout=30)
     assert hanger.returncode is not None
+
+
+# --------------------------------------------- step-granular exact resume --
+
+
+def train_losses(out_dir):
+    return {
+        r["epoch"]: r["train_loss"]
+        for r in history_records(out_dir) if r.get("type") == "epoch"
+    }
+
+
+SNAPSHOT_TRAINING = {"snapshot": {"every_steps": 3}, "scan_steps": 1}
+
+
+@pytest.mark.parametrize(
+    "variant,extra",
+    [
+        ("explicit", {}),
+        ("wus", {"weight_update_sharding": True}),
+        ("bf16_ef", {"comm_hook": "bf16_ef"}),
+    ],
+)
+def test_preempt_at_step_exact_resume_bitwise_parity(tmp_path, variant, extra):
+    """ISSUE 18 acceptance: ``preempt@step=N`` kills the run MID-epoch with
+    the snapshot engine armed; the drain flushes the async writer into a
+    cursor-bearing step snapshot (the flight recording NAMES the flushed
+    step), and the supervised auto-resume continues the epoch AT the
+    recorded step — zero batches replayed, loss trajectory bitwise-equal to
+    an uninterrupted same-seed twin. Across the explicit, weight-update-
+    sharded, and error-feedback-compressed paths."""
+    overrides = json.dumps(dict(SNAPSHOT_TRAINING, **extra))
+    twin = tmp_path / "twin"
+    out = tmp_path / "run"
+    ref = run_train_worker(
+        twin, 2, env=chaos_env(TPUDDP_CHAOS_TRAINING=overrides)
+    )
+    assert ref.returncode == 0, ref.stdout[-2000:] + ref.stderr[-2000:]
+
+    first = run_train_worker(
+        out, 2,
+        env=chaos_env(
+            TPUDDP_CHAOS_TRAINING=overrides, TPUDDP_FAULT="preempt@step=5"
+        ),
+    )
+    assert first.returncode == EXIT_PREEMPTED, (
+        first.stdout[-2000:] + first.stderr[-2000:]
+    )
+    assert "drained snapshot writer" in first.stdout
+    # the drain's artifact is a STEP snapshot (v4 cursor), not ckpt_0.npz
+    steps = sorted(
+        f for f in os.listdir(out)
+        if f.startswith("ckpt_0_s") and f.endswith(".npz")
+    )
+    assert steps and not os.path.exists(os.path.join(str(out), "ckpt_0.npz"))
+    cur = ckpt.read_cursor(os.path.join(str(out), steps[-1]))
+    assert cur["epoch"] == 0 and cur["plan_key"]
+    drained_step = cur["step"]
+    # satellite contract: the exit-75 flight recording names both the
+    # writer-flushed step and the final drain step
+    with open(os.path.join(str(out), "flightrec_preempt.json")) as f:
+        notes = json.load(f)["notes"]
+    assert notes["snapshot_final_step"] == drained_step
+    assert "snapshot_flushed_step" in notes
+    assert notes["snapshot_last"]["path"] in steps
+
+    # requeue through the restart supervisor — the scheduler-shaped path
+    resumed = subprocess.run(
+        [
+            sys.executable, "-u", SUPERVISE,
+            "--world", "4", "--max-restarts", "2", "--auto-resume",
+            "--backoff-base", "0.2",
+            "--",
+            sys.executable, "-u", TRAIN_WORKER, str(out), "2",
+        ],
+        env=chaos_env(TPUDDP_CHAOS_TRAINING=overrides),
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert resumed.returncode == 0, (
+        resumed.stdout[-2000:] + resumed.stderr[-2000:]
+    )
+    assert (
+        f"Exact resume: epoch 0 continues at step {drained_step} "
+        "(zero batches replayed)." in resumed.stdout
+    )
+    # bitwise: the resumed trajectory equals the twin's, both epochs
+    assert train_losses(out) == train_losses(twin)
+    metas = [
+        r for r in history_records(out)
+        if r.get("type") == "run_meta" and isinstance(r.get("snapshot"), dict)
+    ]
+    assert metas and metas[-1]["snapshot"]["every_steps"] == 3
+    validate_history(out)
+
+
+def test_preempt_at_step_managed_exact_resume(tmp_path):
+    """The managed-entrypoint leg: a mid-epoch ``preempt@step`` drains a
+    ``state_<e>_s<s>.npz`` step snapshot whose cursor carries the partial
+    loss accumulator; the requeued run continues AT the step and lands a
+    loss trajectory bitwise-equal to the uninterrupted twin."""
+    overrides = json.dumps({"snapshot": {"every_steps": 1}})
+    twin = tmp_path / "twin"
+    out = tmp_path / "run"
+    ref = run_train_worker(
+        twin, 2, env=chaos_env(TPUDDP_CHAOS_TRAINING=overrides),
+        worker=ACCEL_WORKER,
+    )
+    assert ref.returncode == 0, ref.stdout[-2000:] + ref.stderr[-2000:]
+
+    first = run_train_worker(
+        out, 2,
+        env=chaos_env(
+            TPUDDP_CHAOS_TRAINING=overrides, TPUDDP_FAULT="preempt@step=2"
+        ),
+        worker=ACCEL_WORKER,
+    )
+    assert first.returncode == EXIT_PREEMPTED, (
+        first.stdout[-2000:] + first.stderr[-2000:]
+    )
+    assert "step snapshot for epoch 0" in first.stdout
+    steps = sorted(
+        f for f in os.listdir(out)
+        if f.startswith("state_0_s") and f.endswith(".npz")
+    )
+    assert steps, sorted(os.listdir(out))
+    cur = ckpt.read_cursor(os.path.join(str(out), steps[-1]))
+    drained_step = cur["step"]
+    assert cur["epoch"] == 0 and cur["plan_key"]
+    acc_keys = set(json.loads(json.dumps(list(cur["acc"]))))
+    assert any("loss_total" in k for k in acc_keys)
+    assert any("n_seen" in k for k in acc_keys)
+
+    resumed = run_train_worker(
+        out, 2,
+        env=chaos_env(
+            TPUDDP_CHAOS_TRAINING=overrides, TPUDDP_AUTO_RESUME=1
+        ),
+        worker=ACCEL_WORKER,
+    )
+    assert resumed.returncode == 0, (
+        resumed.stdout[-2000:] + resumed.stderr[-2000:]
+    )
+    assert f"Resumed from step snapshot: epoch 0 step {drained_step}." in (
+        resumed.stdout
+    )
+    assert (
+        f"Exact resume: epoch 0 continues at step {drained_step} "
+        "(zero batches replayed)." in resumed.stdout
+    )
+    assert train_losses(out) == train_losses(twin)
+    validate_history(out)

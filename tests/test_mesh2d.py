@@ -483,7 +483,7 @@ def test_checkpoint_roundtrip_tp2(cpu_devices, tmp_path):
     host = [np.asarray(l).copy() for l in jax.tree_util.tree_leaves(st)]
     ckpt.save_on_main(str(tmp_path), 0, st, world_size=4)
     topo = ckpt.read_topology(str(tmp_path / "ckpt_0.npz"))
-    assert topo["format"] == 3
+    assert topo["format"] == ckpt.FORMAT_VERSION
     assert topo["model_size"] == 2
     assert ckpt.topology_model_size(topo) == 2
     # v3 placement tags: every model-sharded leaf names its mesh axes

@@ -1,0 +1,727 @@
+"""Async step-granular checkpointing (ISSUE 18): config resolution, the v4
+data cursor, async-vs-sync byte identity, mixed-family retention,
+peer-redundant placement, queue-full no-block, and EXACT mid-epoch resume
+with bitwise loss parity — on both the native and managed drivers, all
+in-process on the 8-device CPU world. The subprocess-kill scenarios live in
+test_chaos.py (chaos marker)."""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuddp import optim
+from tpuddp.data import ShardedDataLoader, SyntheticClassification
+from tpuddp.models import ToyMLP
+from tpuddp.nn import CrossEntropyLoss
+from tpuddp.observability import schema as schema_mod
+from tpuddp.parallel.ddp import DistributedDataParallel
+from tpuddp.resilience import integrity, preemption
+from tpuddp.resilience.preemption import TrainingPreempted
+from tpuddp.training import checkpoint as ckpt
+from tpuddp.training import snapshot as snap_mod
+from tpuddp.training.loop import run_training_loop
+from tpuddp.training.snapshot import (
+    EpochTailLoader,
+    SnapshotConfig,
+    SnapshotEngine,
+    acc_from_cursor,
+    epoch_plan_key,
+    resolve_snapshot,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ config
+
+
+def test_resolve_snapshot_off_and_defaults():
+    assert not resolve_snapshot(None).enabled
+    assert not resolve_snapshot(False).enabled
+    on = resolve_snapshot(True)
+    assert on.enabled and on.every_steps == 50
+    assert on.async_writes and on.inflight == 2 and not on.peer_redundancy
+    # the serialized block uses the config KEY "async", not the field name
+    assert resolve_snapshot({"every_steps": 3, "async": False}).as_dict() == {
+        "every_steps": 3, "async": False, "inflight": 2,
+        "peer_redundancy": False,
+    }
+    # every_steps == 0 is a valid explicit off
+    assert not resolve_snapshot({"every_steps": 0}).enabled
+    # idempotent on an already-resolved config
+    cfg = SnapshotConfig(every_steps=7)
+    assert resolve_snapshot(cfg) is cfg
+
+
+def test_resolve_snapshot_refuses_unknown_keys_and_bad_values():
+    with pytest.raises(ValueError, match="every_step"):
+        resolve_snapshot({"every_step": 3})  # typo -> refused, with hint
+    with pytest.raises(ValueError, match="must be a mapping"):
+        resolve_snapshot("every 5")
+    with pytest.raises(ValueError, match="every_steps"):
+        resolve_snapshot({"every_steps": -1})
+    with pytest.raises(ValueError, match="inflight"):
+        resolve_snapshot({"inflight": 0})
+
+
+# ------------------------------------------------------------------ cursor
+
+
+def make_state():
+    from tpuddp.training.train_state import create_train_state
+
+    return create_train_state(
+        ToyMLP(hidden=(8,)), optim.Adam(1e-3), jax.random.key(0),
+        jnp.zeros((1, 4, 4, 3)),
+    )
+
+
+def assert_tree_equal(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+        a, b,
+    )
+
+
+def test_cursor_round_trip(tmp_path):
+    state = make_state()
+    acc = {
+        "loss_sum": jnp.asarray(1.5, jnp.float32),
+        "n": jnp.asarray(192.0, jnp.float32),
+        "ef": jnp.ones((4,), jnp.bfloat16),  # bf16 leaf: the packed lane
+    }
+    path = ckpt.save_on_main(
+        str(tmp_path), 2, state, step=6,
+        cursor={"version": ckpt.FORMAT_VERSION, "epoch": 2, "step": 6,
+                "plan_key": "abcd" * 4},
+        cursor_acc=acc,
+    )
+    assert os.path.basename(path) == "ckpt_2_s6.npz"
+    assert ckpt.read_meta(path) == {"epoch": 2, "completed": 0, "step": 6}
+    cur = ckpt.read_cursor(path)
+    assert cur["epoch"] == 2 and cur["step"] == 6
+    assert cur["plan_key"] == "abcd" * 4
+    assert cur["version"] == ckpt.FORMAT_VERSION
+    got = acc_from_cursor(cur)
+    assert set(got) == {"loss_sum", "n", "ef"}
+    np.testing.assert_array_equal(got["loss_sum"], np.asarray(1.5, np.float32))
+    assert np.asarray(got["ef"]).dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(got["ef"], np.float32), np.ones((4,), np.float32)
+    )
+    # a full-epoch save carries no cursor
+    full = ckpt.save_on_main(str(tmp_path), 2, state)
+    assert ckpt.read_cursor(full) is None
+    assert acc_from_cursor(None) is None
+
+
+def test_restore_latest_surfaces_cursor_and_family_order(tmp_path, caplog):
+    state = make_state()
+    ckpt.save_on_main(str(tmp_path), 0, state)  # full epoch 0
+    ckpt.save_on_main(
+        str(tmp_path), 1, state, step=4,
+        cursor={"epoch": 1, "step": 4, "plan_key": "k1"},
+    )
+    # the step snapshot of epoch 1 outranks the full epoch-0 file
+    cursor_out = []
+    with caplog.at_level(logging.WARNING, logger="tpuddp"):
+        _, next_epoch = ckpt.restore_latest(
+            str(tmp_path), state, cursor_out=cursor_out
+        )
+    assert next_epoch == 1  # the cursor's epoch: continue it, don't redo
+    (entry,) = cursor_out
+    assert entry["step"] == 4 and entry["plan_key"] == "k1"
+    assert entry["provenance"] == "local"
+    assert any("zero batches replayed" in r.message for r in caplog.records)
+    # ...but a full-epoch save of the SAME epoch ranks newer than its steps
+    ckpt.save_on_main(str(tmp_path), 1, state)
+    cursor_out = []
+    _, next_epoch = ckpt.restore_latest(
+        str(tmp_path), state, cursor_out=cursor_out
+    )
+    assert next_epoch == 2 and cursor_out == []
+
+
+# ------------------------------------------------------------ byte identity
+
+
+def test_async_snapshot_byte_identical_to_sync_save(tmp_path):
+    """The matrix: engine-async, engine-sync, and a direct synchronous
+    ``save_on_main`` of the same (state, cursor) must publish byte-identical
+    ``.npz`` and ``.sha256`` files — mode-dependent facts (writer stats)
+    live in the ``.writer.json`` sidecar, never the payload."""
+    state = make_state()
+    pk = "plan" * 4
+    dirs = {}
+    for mode, async_writes in (("async", True), ("sync", False)):
+        d = tmp_path / mode
+        engine = SnapshotEngine(
+            str(d),
+            SnapshotConfig(every_steps=4, async_writes=async_writes),
+        )
+        assert engine.maybe(state, epoch=0, step=4, plan_key=pk)
+        assert engine.flush() == 4
+        engine.close()
+        dirs[mode] = d
+    d = tmp_path / "direct"
+    ckpt.save_on_main(
+        str(d), 0, state, step=4,
+        cursor={"version": ckpt.FORMAT_VERSION, "epoch": 0, "step": 4,
+                "plan_key": pk},
+    )
+    dirs["direct"] = d
+    blobs = {
+        mode: (d / "ckpt_0_s4.npz").read_bytes() for mode, d in dirs.items()
+    }
+    assert blobs["async"] == blobs["sync"] == blobs["direct"]
+    manifests = {
+        mode: (d / "ckpt_0_s4.npz.sha256").read_bytes()
+        for mode, d in dirs.items()
+    }
+    assert manifests["async"] == manifests["sync"] == manifests["direct"]
+    # writer stats exist for the engine modes, outside the payload
+    ws = snap_mod.read_writer_stats(str(dirs["async"] / "ckpt_0_s4.npz"))
+    assert ws["snapshots"] == 1 and ws["async"] is True
+    with np.load(dirs["async"] / "ckpt_0_s4.npz") as f:
+        assert not any("writer" in k for k in f.files)
+
+
+# ---------------------------------------------------------------- retention
+
+
+def test_keep_last_orders_mixed_families_and_keeps_newest_full(tmp_path):
+    """Retention across interleaved step/epoch files: keep_last counts by
+    (epoch, step) recency, and the newest INTACT full-epoch checkpoint is
+    never collected even when step snapshots outrank it."""
+    state = make_state()
+    ckpt.save_on_main(str(tmp_path), 0, state)  # full epoch 0
+    for s in (2, 4):
+        ckpt.save_on_main(str(tmp_path), 1, state, step=s)
+    ckpt.prune_checkpoints(str(tmp_path), keep_last=2)
+    kept = sorted(f for f in os.listdir(tmp_path) if f.endswith(".npz"))
+    # keep_last=2 keeps the two newest (both epoch-1 steps) AND the hard
+    # floor keeps ckpt_0.npz — the only epoch-granular fallback left
+    assert kept == ["ckpt_0.npz", "ckpt_1_s2.npz", "ckpt_1_s4.npz"]
+    # a full-epoch save of epoch 1 now outranks its own step snapshots:
+    # the steps age out, the new full file is the floor
+    ckpt.save_on_main(str(tmp_path), 1, state, keep_last=2)
+    kept = sorted(f for f in os.listdir(tmp_path) if f.endswith(".npz"))
+    assert kept == ["ckpt_1.npz", "ckpt_1_s4.npz"]
+    sidecars = sorted(f for f in os.listdir(tmp_path) if f.endswith(".sha256"))
+    assert sidecars == ["ckpt_1.npz.sha256", "ckpt_1_s4.npz.sha256"]
+
+
+def test_stale_tmp_sweep_covers_step_files(tmp_path):
+    state = make_state()
+    ckpt.save_on_main(str(tmp_path), 0, state, step=3)
+    (tmp_path / "ckpt_0_s6.npz.tmp").write_bytes(b"half")
+    (tmp_path / "ckpt_0_s6.npz.sha256.tmp").write_bytes(b"half")
+    assert ckpt.sweep_stale_tmp(str(tmp_path)) == 2
+    assert (tmp_path / "ckpt_0_s3.npz").exists()
+
+
+# ----------------------------------------------------------- peer redundancy
+
+
+def test_peer_spill_and_restore_from_peer(tmp_path, monkeypatch, caplog):
+    """With peer_redundancy on, the engine spills each published snapshot
+    into the ring neighbor's directory under the heartbeat channel; losing
+    the local copy must still yield a full restore, with the peer
+    provenance logged and surfaced."""
+    hb = tmp_path / "hb"
+    monkeypatch.setenv("TPUDDP_HEARTBEAT_DIR", str(hb))
+    local = tmp_path / "run"
+    state = make_state()
+    engine = SnapshotEngine(
+        str(local),
+        SnapshotConfig(every_steps=2, async_writes=False, peer_redundancy=True),
+    )
+    assert engine.maybe(state, epoch=0, step=2, plan_key="pk")
+    engine.close()
+    peer_file = hb / "peer_ckpt" / "ring_0" / "ckpt_0_s2.npz"
+    assert peer_file.exists()
+    assert integrity.verify_file(str(peer_file))
+    assert ckpt.peer_checkpoint_dirs(str(local)) == [
+        str(hb / "peer_ckpt" / "ring_0")
+    ]
+    # the peer copy is byte-identical to the local publish
+    assert peer_file.read_bytes() == (local / "ckpt_0_s2.npz").read_bytes()
+    # lose the local host's checkpoint directory entirely
+    os.remove(local / "ckpt_0_s2.npz")
+    os.remove(local / "ckpt_0_s2.npz.sha256")
+    found = ckpt._latest_any(str(local))
+    assert found is not None
+    path, epoch, step, prov = found
+    assert (epoch, step, prov) == (0, 2, "peer:ring_0")
+    cursor_out = []
+    with caplog.at_level(logging.WARNING, logger="tpuddp"):
+        restored, next_epoch = ckpt.restore_latest(
+            str(local), state, cursor_out=cursor_out
+        )
+    assert next_epoch == 0
+    assert cursor_out[0]["provenance"] == "peer:ring_0"
+    assert_tree_equal(restored.params, state.params)
+    assert any("provenance=peer:ring_0" in r.message for r in caplog.records)
+
+
+def test_corrupt_local_falls_back_to_peer_copy(tmp_path, monkeypatch):
+    hb = tmp_path / "hb"
+    monkeypatch.setenv("TPUDDP_HEARTBEAT_DIR", str(hb))
+    local = tmp_path / "run"
+    state = make_state()
+    engine = SnapshotEngine(
+        str(local),
+        SnapshotConfig(every_steps=2, async_writes=False, peer_redundancy=True),
+    )
+    assert engine.maybe(state, epoch=0, step=2, plan_key="pk")
+    engine.close()
+    # torn local write: header garbage, manifest now stale
+    with open(local / "ckpt_0_s2.npz", "r+b") as f:
+        f.write(b"\x00CHAOS\x00")
+        f.truncate(64)
+    path, epoch, step, prov = ckpt._latest_any(str(local))
+    assert prov == "peer:ring_0" and (epoch, step) == (0, 2)
+
+
+# -------------------------------------------------------- queue-full no-block
+
+
+def test_full_writer_queue_skips_without_blocking(tmp_path, monkeypatch):
+    """The no-stall contract: a full bounded queue means the snapshot is
+    SKIPPED (counted), never waited for — maybe() must return immediately
+    even while the writer is wedged mid-serialize."""
+    state = make_state()
+    gate = threading.Event()
+    real_save = ckpt.save
+
+    def slow_save(*args, **kwargs):
+        gate.wait(timeout=30)
+        return real_save(*args, **kwargs)
+
+    monkeypatch.setattr(ckpt, "save", slow_save)
+    engine = SnapshotEngine(
+        str(tmp_path), SnapshotConfig(every_steps=1, inflight=1)
+    )
+    try:
+        assert engine.maybe(state, epoch=0, step=1, plan_key="pk")
+        # the writer thread is now wedged inside slow_save; fill the queue
+        deadline = time.time() + 10
+        queued = False
+        while time.time() < deadline:
+            if engine.maybe(state, epoch=0, step=engine._next_due, plan_key="pk"):
+                queued = True
+                break
+            time.sleep(0.01)
+        assert queued  # inflight=1 slot occupied while the writer is wedged
+        t0 = time.perf_counter()
+        took = engine.maybe(state, epoch=0, step=engine._next_due, plan_key="pk")
+        elapsed = time.perf_counter() - t0
+        assert not took
+        assert elapsed < 1.0  # skipped, not blocked on the wedged writer
+        assert engine.stats["skipped_queue_full"] >= 1
+    finally:
+        gate.set()
+        engine.close()
+    assert engine.stats["snapshots"] == 2
+
+
+# ------------------------------------------------------------ plan key / tail
+
+
+class _Delegating:
+    """Test wrapper with an ``inner`` attr — the shape of the chaos/test
+    loaders the plan key must see through."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def __len__(self):
+        return len(self.inner)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def test_epoch_plan_key_wrapper_invariance_and_sensitivity(mesh):
+    ds = SyntheticClassification(n=128, shape=(4, 4, 3), seed=0)
+    loader = ShardedDataLoader(ds, 4, mesh, shuffle=True)
+    key = epoch_plan_key(loader, 0)
+    assert epoch_plan_key(_Delegating(loader), 0) == key
+    assert epoch_plan_key(EpochTailLoader(loader, 0), 0) == key
+    # anything that changes the batch order changes the key
+    assert epoch_plan_key(loader, 1) != key
+    other = ShardedDataLoader(
+        SyntheticClassification(n=128, shape=(4, 4, 3), seed=1),
+        4, mesh, shuffle=True, seed=7,
+    )
+    assert epoch_plan_key(other, 0) != key
+    # stable across processes/runs: a pure function of the plan inputs
+    assert epoch_plan_key(loader, 0) == key
+
+
+def test_epoch_tail_loader_zero_replay():
+    fetched = []
+
+    class Planned:
+        def __len__(self):
+            return 8
+
+        def make_batch_plan(self):
+            def fetch(s):
+                fetched.append(s)
+                return s * 10
+            return 8, fetch
+
+    tail = EpochTailLoader(Planned(), 5)
+    assert len(tail) == 3
+    assert list(tail) == [50, 60, 70]
+    assert fetched == [5, 6, 7]  # the applied prefix was never assembled
+
+    class Unplanned:
+        def __iter__(self):
+            return iter(range(8))
+
+        def __len__(self):
+            return 8
+
+    assert list(EpochTailLoader(Unplanned(), 6)) == [6, 7]
+
+
+# ------------------------------------------------- exact resume (native) ----
+
+
+@pytest.fixture
+def preempt_guard(monkeypatch):
+    monkeypatch.setenv("TPUDDP_PREEMPT_GRACE", "3600")
+    preemption.reset_preemption()
+    yield
+    preemption.reset_preemption()
+
+
+class _PreemptingLoader:
+    def __init__(self, inner, after):
+        self.inner = inner
+        self.after = after
+
+    def __len__(self):
+        return len(self.inner)
+
+    def set_epoch(self, epoch):
+        self.inner.set_epoch(epoch)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def __iter__(self):
+        for i, batch in enumerate(self.inner):
+            if i == self.after:
+                preemption.request_preemption()
+            yield batch
+
+
+def _toy_ddp(mesh):
+    ds = SyntheticClassification(n=512, shape=(8, 8, 3), seed=0)
+    loader = ShardedDataLoader(ds, 8, mesh, shuffle=True)
+    test_loader = ShardedDataLoader(ds, 8, mesh, shuffle=True)
+    ddp = DistributedDataParallel(
+        ToyMLP(hidden=(16,)), optim.Adam(1e-2), CrossEntropyLoss(), mesh=mesh
+    )
+    state = ddp.init_state(jax.random.key(0), jnp.zeros((1, 8, 8, 3)))
+    return ddp, state, loader, test_loader
+
+
+SNAP = {"every_steps": 3, "async": True, "inflight": 2}
+
+
+def test_native_exact_resume_bitwise_parity(mesh, tmp_path, preempt_guard):
+    """The tentpole contract end-to-end: SIGTERM mid-epoch with the engine
+    armed -> the drain flushes the async writer and lands a step snapshot
+    -> auto_resume continues the epoch AT the recorded step (zero batches
+    replayed) -> the loss trajectory is BITWISE-equal to an uninterrupted
+    same-seed run. Retires the 'redo the interrupted epoch' contract."""
+    ref_dir = tmp_path / "ref"
+    run_dir = tmp_path / "run"
+    ddp, state, loader, test_loader = _toy_ddp(mesh)
+    _, hist_ref = run_training_loop(
+        ddp, state, loader, test_loader, str(ref_dir), num_epochs=2,
+        checkpoint_epoch=1, scan_steps=1, snapshot=SNAP, log=lambda *_: None,
+    )
+    ref = {h["epoch"]: h["train_loss"] for h in hist_ref}
+
+    preemption.reset_preemption()
+    ddp, state, loader, test_loader = _toy_ddp(mesh)
+    with pytest.raises(TrainingPreempted) as ei:
+        run_training_loop(
+            ddp, state, _PreemptingLoader(loader, after=5), test_loader,
+            str(run_dir), num_epochs=2, checkpoint_epoch=1, scan_steps=1,
+            snapshot=SNAP, log=lambda *_: None,
+        )
+    assert ei.value.epoch == 0
+    # the drain reused the writer's flush path: the emergency artifact IS a
+    # step snapshot (cursor-bearing), not a legacy ckpt_0.npz. The exact
+    # drained step depends on how many staged batches the pipeline had
+    # dispatched when the poll caught the flag — read it from the cursor.
+    steps = sorted(
+        f for f in os.listdir(run_dir)
+        if f.startswith("ckpt_0_s") and f.endswith(".npz")
+    )
+    assert steps and not (run_dir / "ckpt_0.npz").exists()
+    snap_file = run_dir / steps[-1]
+    assert integrity.verify_file(str(snap_file))
+    cur = ckpt.read_cursor(str(snap_file))
+    drained_step = cur["step"]
+    assert cur["epoch"] == 0 and drained_step >= 3 and cur["plan_key"]
+    assert set(acc_from_cursor(cur)) == {"loss_sum", "n"}
+    # the PERIODIC async snapshot at the every_steps=3 cadence published
+    assert (run_dir / "ckpt_0_s3.npz").exists()
+
+    preemption.reset_preemption()
+    ddp, state, loader, test_loader = _toy_ddp(mesh)
+    logs = []
+    _, hist = run_training_loop(
+        ddp, state, loader, test_loader, str(run_dir), num_epochs=2,
+        checkpoint_epoch=1, scan_steps=1, snapshot=SNAP, auto_resume=True,
+        log=lambda *a: logs.append(" ".join(map(str, a))),
+    )
+    assert any(
+        f"Exact resume: epoch 0 continues at step {drained_step} "
+        "(zero batches replayed)." in l for l in logs
+    )
+    got = {h["epoch"]: h["train_loss"] for h in hist}
+    assert got == ref  # bitwise: == on the exact floats, both epochs
+    # v11 provenance: every run_meta header carries the snapshot block
+    with open(run_dir / "history.jsonl") as f:
+        records = [json.loads(l) for l in f if l.strip()]
+    metas = [r for r in records if r["type"] == "run_meta"]
+    assert metas and all(
+        m["snapshot"]["every_steps"] == 3 for m in metas
+    )
+    errs = schema_mod.validate_history_records(records)
+    assert errs == []
+
+
+def test_native_plan_key_mismatch_falls_back_to_redo(
+    mesh, tmp_path, preempt_guard, caplog
+):
+    """A cursor whose plan key no longer matches (here: the snapshot was
+    cut on a different shuffle seed) must NOT skip wrong batches — the
+    driver redoes the epoch from the restored state, the pre-v4 contract."""
+    ddp, state, loader, test_loader = _toy_ddp(mesh)
+    with pytest.raises(TrainingPreempted):
+        run_training_loop(
+            ddp, state, _PreemptingLoader(loader, after=5), test_loader,
+            str(tmp_path), num_epochs=1, checkpoint_epoch=1, scan_steps=1,
+            snapshot=SNAP, log=lambda *_: None,
+        )
+    preemption.reset_preemption()
+    ddp, state, _, test_loader = _toy_ddp(mesh)
+    ds = SyntheticClassification(n=512, shape=(8, 8, 3), seed=0)
+    other_loader = ShardedDataLoader(ds, 8, mesh, shuffle=True, seed=9)
+    with caplog.at_level(logging.WARNING, logger="tpuddp"):
+        _, hist = run_training_loop(
+            ddp, state, other_loader, test_loader, str(tmp_path),
+            num_epochs=1, checkpoint_epoch=1, scan_steps=1, snapshot=SNAP,
+            auto_resume=True, log=lambda *_: None,
+        )
+    assert any("plan key mismatch" in r.message for r in caplog.records)
+    assert [h["epoch"] for h in hist] == [0]  # epoch redone, run completed
+
+
+def test_native_snapshot_on_off_zero_semantic_cost(mesh, tmp_path):
+    """Arming the engine must not change training semantics or the step
+    program: same-seed runs with snapshots on and off land bitwise-equal
+    loss trajectories and final checkpoints, and the lowered step HLO is
+    byte-identical."""
+    hlo = {}
+    hist = {}
+    for key, snap in (("on", SNAP), ("off", None)):
+        d = tmp_path / key
+        ddp, state, loader, test_loader = _toy_ddp(mesh)
+        _, h = run_training_loop(
+            ddp, state, loader, test_loader, str(d), num_epochs=1,
+            checkpoint_epoch=1, scan_steps=1, snapshot=snap,
+            log=lambda *_: None,
+        )
+        hist[key] = [(r["epoch"], r["train_loss"], r["test_loss"]) for r in h]
+        state_struct = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(np.shape(l), l.dtype), state
+        )
+        batch_struct = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(np.shape(l), np.asarray(l).dtype),
+            next(iter(loader)),
+        )
+        hlo[key] = jax.jit(ddp.train_step).lower(
+            state_struct, batch_struct
+        ).as_text()
+    assert hist["on"] == hist["off"]
+    assert hlo["on"] == hlo["off"]
+    template = _toy_ddp(mesh)[1]
+    a = ckpt.load(str(tmp_path / "on" / "ckpt_0.npz"), template)
+    b = ckpt.load(str(tmp_path / "off" / "ckpt_0.npz"), template)
+    assert_tree_equal(a.params, b.params)
+    assert_tree_equal(a.opt_state, b.opt_state)
+
+
+# ------------------------------------------------ exact resume (managed) ----
+
+
+class _ManagedPreempt:
+    def __init__(self, inner, after):
+        self.inner = inner
+        self.after = after
+
+    def __len__(self):
+        return len(self.inner)
+
+    def set_epoch(self, epoch):
+        self.inner.set_epoch(epoch)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def __iter__(self):
+        for i, batch in enumerate(self.inner):
+            if i == self.after:
+                preemption.request_preemption()
+            yield batch
+
+
+def _managed_setup():
+    import train_accelerate as ta
+    from tpuddp import nn as tnn
+    from tpuddp.accelerate import Accelerator
+    from tpuddp.data import DataLoader
+    from tpuddp.data.transforms import make_eval_transform
+
+    accel = Accelerator(seed=0, fuse_steps=1)
+    ds = SyntheticClassification(n=256, shape=(8, 8, 3), seed=0)
+    train_loader = DataLoader(ds, batch_size=8, shuffle=True)
+    test_loader = DataLoader(ds, batch_size=32)
+    model, opt, prepared = accel.prepare(
+        ToyMLP(hidden=(16,)), optim.Adam(1e-2), train_loader
+    )
+    criterion = tnn.CrossEntropyLoss()
+    eval_tf = jax.jit(make_eval_transform(size=None))
+    return ta, accel, model, opt, prepared, test_loader, criterion, eval_tf
+
+
+def _managed_losses(save_dir):
+    with open(os.path.join(save_dir, "history.jsonl")) as f:
+        records = [json.loads(l) for l in f if l.strip()]
+    return records, {
+        r["epoch"]: r["train_loss"] for r in records if r["type"] == "epoch"
+    }
+
+
+def test_managed_exact_resume_bitwise_parity(tmp_path, preempt_guard):
+    """The managed driver's leg: a mid-epoch preempt drains a step snapshot
+    (state_<e>_s<s>.npz with the v4 cursor), load_state surfaces the
+    cursor, and the resumed run's loss trajectory is bitwise-equal to the
+    uninterrupted twin — carried partial accumulator included."""
+    snap = {"every_steps": 1}
+    ref_dir, run_dir = str(tmp_path / "ref"), str(tmp_path / "run")
+    ta, accel, model, opt, prepared, test_loader, crit, etf = _managed_setup()
+    ta.run_training_loop(
+        model, prepared, test_loader, crit, opt, ref_dir, accel, None, etf,
+        num_epochs=2, checkpoint_epoch=1, snapshot=snap,
+    )
+    _, ref = _managed_losses(ref_dir)
+
+    preemption.reset_preemption()
+    ta, accel, model, opt, prepared, test_loader, crit, etf = _managed_setup()
+    with pytest.raises(TrainingPreempted):
+        ta.run_training_loop(
+            model, _ManagedPreempt(prepared, 2), test_loader, crit, opt,
+            run_dir, accel, None, etf, num_epochs=2, checkpoint_epoch=1,
+            snapshot=snap,
+        )
+    snap_file = os.path.join(run_dir, "state_0_s3.npz")
+    assert os.path.exists(snap_file)
+    cur = ckpt.read_cursor(snap_file)
+    assert cur["epoch"] == 0 and cur["step"] == 3 and cur["plan_key"]
+    assert set(acc_from_cursor(cur)) == {"loss_total", "n_seen"}
+
+    preemption.reset_preemption()
+    ta, accel, model, opt, prepared, test_loader, crit, etf = _managed_setup()
+    img0 = np.asarray(SyntheticClassification(n=256, shape=(8, 8, 3), seed=0)[0][0])
+    model(etf(jnp.asarray(img0[None])))  # lazy init for load_state
+    start = accel.load_state(model, opt, run_dir)
+    assert start == 0  # the cursor's epoch: continue it
+    assert accel.last_restore_cursor["step"] == 3
+    ta.run_training_loop(
+        model, prepared, test_loader, crit, opt, run_dir, accel, None, etf,
+        num_epochs=2, checkpoint_epoch=1, start_epoch=start, snapshot=snap,
+    )
+    records, got = _managed_losses(run_dir)
+    assert got == ref  # bitwise, both epochs
+    metas = [r for r in records if r["type"] == "run_meta"]
+    assert metas and all(m["snapshot"]["mode"] == "drain" for m in metas)
+    assert schema_mod.validate_history_records(records) == []
+
+
+# --------------------------------------------------------------- schema v11
+
+
+def test_schema_v11_requires_snapshot_provenance():
+    """v11 bump: a run_meta stamped at v11+ without the ``snapshot`` field
+    is drift and must be rejected; older headers keep validating at their
+    own version, and make_run_meta always carries the field."""
+    meta = schema_mod.make_run_meta(comm_hook="none", snapshot=SNAP)
+    assert meta["schema_version"] >= 11
+    assert meta["snapshot"]["every_steps"] == 3
+    assert schema_mod.validate_history_records([meta]) == []
+    # disabled engine -> explicit false, never absent
+    off = schema_mod.make_run_meta(comm_hook="none")
+    assert off["snapshot"] is False
+    assert schema_mod.validate_history_records([off]) == []
+    dropped = {k: v for k, v in meta.items() if k != "snapshot"}
+    errs = schema_mod.validate_history_records([dropped])
+    assert any("snapshot" in e for e in errs), errs
+    # a v10 header without the field stays valid (its version's contract)
+    v10 = dict(dropped, schema_version=10)
+    assert schema_mod.validate_history_records([v10]) == []
+
+
+# ------------------------------------------------------------- inspect CLI
+
+
+def test_inspect_ckpt_prints_cursor_and_writer_stats(tmp_path):
+    """``tpuddp_inspect ckpt`` (numpy + stdlib only — no accelerator
+    runtime) must print the v4 cursor, the writer sidecar, and pick the
+    newest file in a dir by (epoch, step) family order."""
+    state = make_state()
+    engine = SnapshotEngine(
+        str(tmp_path), SnapshotConfig(every_steps=4, async_writes=False)
+    )
+    acc = {"loss_sum": jnp.asarray(2.5), "n": jnp.asarray(64.0)}
+    engine.final_snapshot(state, epoch=1, step=4, plan_key="pk" * 8, acc=acc)
+    engine.close()
+    ckpt.save_on_main(str(tmp_path), 0, state)  # older full epoch
+    tool = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    out = subprocess.run(
+        [sys.executable, tool, "ckpt", str(tmp_path)],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "2 checkpoint(s) (1 step snapshot(s))" in out.stdout
+    # dir mode picked the step snapshot of epoch 1 over the full epoch 0
+    assert "ckpt_1_s4.npz" in out.stdout
+    assert "cursor (v4): epoch=1 step=4 plan_key=" + "pk" * 8 in out.stdout
+    assert "loss_sum" in out.stdout and "zero batches replayed" in out.stdout
+    assert "writer: async=False" in out.stdout
+    assert "manifest:" in out.stdout and "verified" in out.stdout
+    # the single-file mode on a cursor-free full checkpoint prints no cursor
+    out = subprocess.run(
+        [sys.executable, tool, "ckpt", str(tmp_path / "ckpt_0.npz")],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0 and "cursor (v4)" not in out.stdout

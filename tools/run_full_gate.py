@@ -109,7 +109,19 @@ reshard-on-load path and the merged history must validate and carry the
 ``(model 2 -> 1)`` topology_change event. Placement-tag drift, a lossy QKV
 relayout, or a reshard that stops recording provenance fails here.
 
-Fleet gate (after the reshard gate): ``tools/fleet.py chaos-demo`` shares
+Snapshot gate (after the reshard gate): the ISSUE 18 exact-resume leg — a
+training run with step-granular async snapshots armed
+(``training.snapshot.every_steps``) is killed MID-epoch via
+``preempt@step=N`` (exit 75; the drain flushes the async writer and lands a
+``ckpt_<epoch>_s<step>.npz`` with a v4 data cursor), ``tpuddp_inspect ckpt``
+must print that cursor, then the run auto-resumes and must (a) log the
+"Exact resume ... zero batches replayed" line, (b) finish with per-epoch
+losses BITWISE-equal to an uninterrupted same-seed twin, and (c) leave a
+schema-v11 history whose run_meta carries the ``snapshot`` provenance
+block. A snapshot drain that replays batches, loses the cursor, or stops
+recording provenance fails here.
+
+Fleet gate (after the snapshot gate): ``tools/fleet.py chaos-demo`` shares
 one CPU-mesh pool between 2 training jobs and 1 serving job under the
 fleet controller (ISSUE 11): one training job is SIGKILLed mid-run and
 resumes elastically, a late high-priority arrival preempts capacity
@@ -660,6 +672,138 @@ def _reshard_gate(env) -> int:
             print("reshard gate: no (model 2 -> 1) topology_change event in "
                   "the resumed history", file=sys.stderr)
             return 1
+    return 0
+
+
+def _snapshot_gate(env) -> int:
+    """Async step-granular checkpointing (ISSUE 18): kill a snapshot-armed
+    run MID-epoch, inspect the cursor-bearing step snapshot, auto-resume to
+    completion, and demand bitwise loss parity with an uninterrupted twin."""
+    import json
+    import re as _re
+
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    worker = os.path.join(REPO, "tests", "_chaos_train_worker.py")
+    overrides = json.dumps({
+        # scan_steps=1 keeps step dispatches batch-granular so the injected
+        # preempt lands mid-epoch between snapshot boundaries
+        "snapshot": {"every_steps": 3}, "scan_steps": 1,
+    })
+    with tempfile.TemporaryDirectory(prefix="tpuddp_snap_gate_") as tmp:
+        out_dir = os.path.join(tmp, "run")
+        twin_dir = os.path.join(tmp, "twin")
+        os.makedirs(out_dir)
+        os.makedirs(twin_dir)
+        base_env = dict(env)
+        base_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "TPUDDP_CHAOS_TRAINING": overrides,
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        # leg 1: the uninterrupted twin — the bitwise reference trajectory
+        rc = subprocess.call(
+            [sys.executable, "-u", worker, twin_dir, "2"],
+            cwd=REPO, env=base_env,
+        )
+        if rc != 0:
+            print(f"snapshot gate: twin run exited {rc}", file=sys.stderr)
+            return rc or 1
+        # leg 2: same seed, killed mid-epoch-0 by an injected SIGTERM; the
+        # drain must flush the async writer and land a step snapshot
+        env1 = dict(base_env)
+        env1["TPUDDP_FAULT"] = "preempt@step=5"
+        rc = subprocess.call(
+            [sys.executable, "-u", worker, out_dir, "2"],
+            cwd=REPO, env=env1,
+        )
+        if rc != 75:
+            print(f"snapshot gate: preempted run exited {rc}, expected 75",
+                  file=sys.stderr)
+            return rc or 1
+        steps = sorted(
+            n for n in os.listdir(out_dir)
+            if _re.match(r"^ckpt_\d+_s\d+\.npz$", n)
+        )
+        if not steps:
+            print("snapshot gate: the drain left no ckpt_<epoch>_s<step>.npz "
+                  f"step snapshot (dir: {sorted(os.listdir(out_dir))})",
+                  file=sys.stderr)
+            return 1
+        # leg 3: the cursor-bearing ckpt summary — tpuddp_inspect must print
+        # the v4 data cursor of the freshest step snapshot
+        out = subprocess.run(
+            [sys.executable, inspect, "ckpt",
+             os.path.join(out_dir, steps[-1])],
+            cwd=REPO, env=env, stdout=subprocess.PIPE, text=True,
+        )
+        sys.stdout.write(out.stdout)
+        if out.returncode != 0:
+            print(f"snapshot gate: tpuddp_inspect ckpt exited "
+                  f"{out.returncode}", file=sys.stderr)
+            return out.returncode
+        if "cursor (v4):" not in out.stdout:
+            print("snapshot gate: inspect summary of the step snapshot "
+                  "prints no v4 cursor", file=sys.stderr)
+            return 1
+        # leg 4: auto-resume — must continue AT the drained step (zero
+        # batches replayed), not redo the epoch
+        env2 = dict(base_env)
+        env2["TPUDDP_AUTO_RESUME"] = "1"
+        out = subprocess.run(
+            [sys.executable, "-u", worker, out_dir, "2"],
+            cwd=REPO, env=env2, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        sys.stdout.write(out.stdout)
+        if out.returncode != 0:
+            print(f"snapshot gate: resumed run exited {out.returncode}",
+                  file=sys.stderr)
+            return out.returncode
+        if "zero batches replayed" not in out.stdout:
+            print("snapshot gate: the resumed run never took the exact-"
+                  "resume path (no 'zero batches replayed' line)",
+                  file=sys.stderr)
+            return 1
+        # leg 5: bitwise loss parity + schema-v11 provenance
+        def epoch_losses(run_dir):
+            with open(os.path.join(run_dir, "history.jsonl")) as f:
+                records = [json.loads(l) for l in f if l.strip()]
+            return records, {
+                r["epoch"]: r["train_loss"]
+                for r in records if r["type"] == "epoch"
+            }
+
+        records, resumed = epoch_losses(out_dir)
+        _, ref = epoch_losses(twin_dir)
+        if resumed != ref:
+            print(f"snapshot gate: resumed losses {resumed} are not bitwise-"
+                  f"equal to the uninterrupted twin's {ref}", file=sys.stderr)
+            return 1
+        metas = [r for r in records if r["type"] == "run_meta"]
+        if not any(
+            isinstance(m.get("snapshot"), dict)
+            and m["snapshot"].get("every_steps") == 3
+            for m in metas
+        ):
+            print("snapshot gate: no run_meta carries the snapshot "
+                  "provenance block", file=sys.stderr)
+            return 1
+        rc = subprocess.call(
+            [sys.executable, inspect, "--validate",
+             os.path.join(out_dir, "history.jsonl")],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("snapshot gate: merged history.jsonl failed validation",
+                  file=sys.stderr)
+            return rc
+        print(
+            "snapshot gate: mid-epoch kill drained to step snapshot "
+            f"{steps[-1]}, cursor inspected, exact resume replayed zero "
+            "batches, losses bitwise-equal to the twin, v11 provenance "
+            "verified"
+        )
     return 0
 
 
@@ -1490,6 +1634,9 @@ def main(argv=None):
     if rc != 0:
         return rc
     rc = _reshard_gate(env)
+    if rc != 0:
+        return rc
+    rc = _snapshot_gate(env)
     if rc != 0:
         return rc
     rc = _fleet_gate(env)

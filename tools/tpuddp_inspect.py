@@ -659,8 +659,12 @@ def summarize_bench(path: str) -> None:
 
 def summarize_ckpt(path: str) -> int:
     """Print one checkpoint's recorded topology, shard tags, placement
-    table, and manifest status. Returns 0 (1 when the manifest mismatches —
-    a torn file an operator should know about before trusting it)."""
+    table, v4 data cursor (step snapshots), writer statistics, peer-shard
+    provenance, and manifest status. Returns 0 (1 when the manifest
+    mismatches — a torn file an operator should know about before trusting
+    it)."""
+    import json as _json
+
     import numpy as np
 
     reshard = _load_reshard()
@@ -670,14 +674,53 @@ def summarize_ckpt(path: str) -> int:
     topo = reshard.parse_topology(stored)
     leaves = [
         k for k in stored
-        if k != reshard.TOPO_MARK and not k.startswith(reshard.META_MARK)
+        if k != reshard.TOPO_MARK
+        and not k.startswith(reshard.META_MARK)
+        and not k.startswith(reshard.CURSOR_MARK)
     ]
     n_bf16 = sum(1 for k in leaves if k.startswith(reshard.BF16_MARK))
     n_keys = sum(1 for k in leaves if k.startswith(reshard.KEY_MARK))
     total_b = sum(int(stored[k].nbytes) for k in leaves)
     print(f"checkpoint: {path}")
+    # peer-redundant spill provenance: the file's own location says whether
+    # this is a host's local checkpoint or a ring-neighbor copy under the
+    # heartbeat channel's peer_ckpt/ directory
+    parts = os.path.normpath(os.path.abspath(path)).split(os.sep)
+    if "peer_ckpt" in parts:
+        ring = parts[parts.index("peer_ckpt") + 1] if (
+            parts.index("peer_ckpt") + 1 < len(parts)
+        ) else "?"
+        print(f"  provenance: peer-redundant spill ({ring} — a ring "
+              "neighbor's copy; restore prefers freshest-intact across "
+              "local + peers)")
     print(f"  leaves: {len(leaves)} ({n_bf16} bf16-packed, {n_keys} PRNG "
           f"key(s)), {total_b:,} payload bytes")
+    # v4 data cursor: the exact-resume record of a step-granular snapshot
+    if "__cursor__" in stored:
+        cur = _json.loads(str(np.asarray(stored["__cursor__"]).item()))
+        acc_keys = cur.get("acc_keys") or []
+        print(f"  cursor (v{cur.get('version')}): epoch={cur.get('epoch')} "
+              f"step={cur.get('step')} plan_key={cur.get('plan_key')}")
+        if acc_keys:
+            names = [k[len("__cursor_acc__"):] for k in acc_keys]
+            print(f"  cursor accumulator: {len(acc_keys)} partial metric "
+                  f"leaf(s) {names}")
+        print("  resume: exact — the driver continues this epoch AT the "
+              "recorded step (zero batches replayed) when the plan key "
+              "matches")
+    # async-writer statistics sidecar (deliberately outside the payload:
+    # the npz must stay byte-identical between async and sync writers)
+    try:
+        with open(path + ".writer.json", "r", encoding="utf-8") as wf:
+            ws = _json.load(wf)
+    except (OSError, ValueError):
+        ws = None
+    if ws is not None:
+        print(f"  writer: async={ws.get('async')} inflight={ws.get('inflight')} "
+              f"snapshots={ws.get('snapshots')} "
+              f"skipped_queue_full={ws.get('skipped_queue_full')} "
+              f"write_s={ws.get('write_s')} bytes={ws.get('bytes'):,} "
+              f"peer_redundancy={ws.get('peer_redundancy')}")
     if topo is None:
         print("  topology: MISSING (format v1 — predates shard provenance; "
               "resharding refuses this file, resume it at model=1 or re-save "
@@ -732,16 +775,30 @@ def ckpt_main(argv) -> int:
         import re as _re
 
         names = sorted(os.listdir(args.path))
-        ckpts = [n for n in names if _re.match(r"^ckpt_\d+\.npz$", n)]
+        pat = _re.compile(r"^ckpt_(\d+)(?:_s(\d+))?\.npz$")
+        matched = [(n, pat.match(n)) for n in names]
+        ckpts = [(n, m) for n, m in matched if m]
+        n_steps = sum(1 for _, m in ckpts if m.group(2) is not None)
         stale = [
             n for n in names
-            if _re.match(r"^ckpt_\d+\.npz(\.sha256)?\.tmp$", n)
+            if _re.match(r"^ckpt_\d+(_s\d+)?\.npz(\.sha256)?\.tmp$", n)
         ]
-        print(f"{args.path}: {len(ckpts)} checkpoint(s), {len(stale)} stale "
-              ".tmp file(s)" + (f" {stale}" if stale else ""))
+        steps_note = f" ({n_steps} step snapshot(s))" if n_steps else ""
+        print(f"{args.path}: {len(ckpts)} checkpoint(s){steps_note}, "
+              f"{len(stale)} stale .tmp file(s)"
+              + (f" {stale}" if stale else ""))
         if not ckpts:
             return 0
-        newest = max(ckpts, key=lambda n: int(n[len("ckpt_"):-len(".npz")]))
+
+        # same family ordering as restore_latest: a full-epoch save ranks
+        # newer than any step snapshot of the same epoch
+        def family(item):
+            _, m = item
+            step = m.group(2)
+            return (int(m.group(1)), 1 if step is None else 0,
+                    0 if step is None else int(step))
+
+        newest = max(ckpts, key=family)[0]
         print()
         return summarize_ckpt(os.path.join(args.path, newest))
     if not os.path.isfile(args.path):

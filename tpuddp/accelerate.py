@@ -149,22 +149,24 @@ class LazyLoss:
         return self.item()
 
 
-def sum_losses(losses):
+def sum_losses(losses, initial=None):
     """Epoch-end device sum of many :class:`LazyLoss` values with the fewest
     device ops: losses that came out of the same fused-scan flush share one
     ``(K,)`` loss array and are summed array-at-a-time (two ops per flush)
     instead of scalar-at-a-time (two ops per batch — measured to dominate the
     steps themselves on dispatch-latency-bound runtimes). Returns a device
-    scalar (0.0 for an empty sequence); ``float()`` it for the host value."""
+    scalar (0.0 for an empty sequence); ``float()`` it for the host value.
+    ``initial`` seeds the sum — an exact mid-epoch resume carries the
+    interrupted run's partial loss total through it."""
     import jax.numpy as _jnp
 
     losses = list(losses)
     if not losses:
-        return _jnp.asarray(0.0)
+        return _jnp.asarray(0.0 if initial is None else initial)
     for l in losses:
         if l._value is None and l._queued_on is not None:
             l._queued_on.flush()  # one flush settles every queued loss
-    total = None
+    total = None if initial is None else _jnp.asarray(initial)
     by_stack = {}  # id(array) -> [array, [indices]]
     for l in losses:
         if l._value is None and l._value_src is not None:
@@ -1665,13 +1667,20 @@ class Accelerator:
         optimizer: "PreparedOptimizer",
         save_dir: str,
         epoch: int = 0,
+        step: Optional[int] = None,
+        cursor: Optional[dict] = None,
     ):
         """Lossless full-training-state save — the HF ``save_state`` analog
         (``save_model`` keeps the reference's weights-only contract,
         multi-GPU-training-accelerate.py:104-108; this adds what a restart
         needs): process 0 writes ``save_dir/state_{epoch}.npz`` holding
         params, model buffers, optimizer moments, and the RNG stream
-        position, so :meth:`load_state` resumes bit-for-bit."""
+        position, so :meth:`load_state` resumes bit-for-bit.
+
+        ``step``/``cursor`` write a STEP-granular snapshot instead
+        (``state_{epoch}_s{step}.npz`` with the v4 data cursor): the
+        mid-epoch drain path — :meth:`load_state` then resumes AT that
+        step with zero batches replayed."""
         model._flush_queues()  # queued fused steps are committed updates
         model._check_not_lost()
         if model._params is None:
@@ -1686,12 +1695,21 @@ class Accelerator:
                 "first (the entrypoint's epoch boundary does)"
             )
         tree = self._full_state_like(model, optimizer)
+        cursor_acc = None
+        if cursor is not None:
+            cursor = dict(cursor)
+            cursor.setdefault("version", ckpt.FORMAT_VERSION)
+            cursor.setdefault("epoch", int(epoch))
+            if step is not None:
+                cursor.setdefault("step", int(step))
+            cursor_acc = cursor.pop("acc", None)
         # one writer discipline for every checkpoint flavor: cross-host
         # gather (collective) -> process-0 write -> barrier; world_size
         # stamps the v2 topology record so the state can reshard elastically
         ckpt.save_on_main(
             save_dir, epoch, tree, prefix="state",
             world_size=int(self.mesh.devices.size),
+            step=step, cursor=cursor, cursor_acc=cursor_acc,
         )
 
     def load_state(
@@ -1701,7 +1719,15 @@ class Accelerator:
         :meth:`save_state` (the managed resume path). Returns the next epoch
         to train (0 when no state file exists — fresh start). The model must
         be initialized (one forward, even a lazy un-materialized one,
-        suffices) so the structure to load into exists."""
+        suffices) so the structure to load into exists.
+
+        A step-granular snapshot (``state_{epoch}_s{step}.npz``, written by
+        a mid-epoch drain) restores too: its v4 data cursor lands in
+        ``self.last_restore_cursor`` and the return value is the cursor's
+        OWN epoch — the driver continues that epoch at the cursor step with
+        zero batches replayed. ``last_restore_cursor`` is None after an
+        epoch-granular restore."""
+        self.last_restore_cursor = None
         found = ckpt.latest(save_dir, prefix="state")
         if found is None:
             # fresh start: a no-op call must not touch in-flight work
@@ -1736,7 +1762,21 @@ class Accelerator:
         self.last_restore_events = ckpt.build_reshard_events(
             path, epoch, topo, world, actions
         )
-        next_epoch = epoch + 1
+        cursor = ckpt.read_cursor(path)
+        if cursor is not None and actions:
+            # a resharded restore changed the data order the cursor's plan
+            # key describes — poison it so the driver redoes the epoch
+            # instead of resuming a plan that no longer exists
+            cursor["plan_key"] = None
+        meta = ckpt.read_meta(path)
+        if cursor is not None:
+            self.last_restore_cursor = cursor
+            next_epoch = int(cursor.get("epoch", epoch))
+        elif not meta.get("completed", 1):
+            # legacy emergency save (no cursor): redo the interrupted epoch
+            next_epoch = epoch
+        else:
+            next_epoch = epoch + 1
         model._params, model._model_state = replicate(
             self.mesh, (restored["params"], restored["model_state"])
         )

@@ -112,6 +112,17 @@ TRAINING_DEFAULTS = {
     # reshard lands typed topology_change/comm_state_reset event rows.
     "keep_last": None,  # checkpoint retention: prune all but the K newest
     # ckpt_{epoch}.npz (+ .sha256 manifests) after each save; None keeps all
+    "snapshot": None,  # async step-granular checkpointing (training/
+    # snapshot.py): None/false -> off (epoch-granular checkpoints only);
+    # true -> defaults {every_steps: 50, async: true, inflight: 2,
+    # peer_redundancy: false}; a dict overrides the defaults with unknown-key
+    # refusal. Armed, the loop snapshots TrainState every N optimizer steps
+    # between dispatches (on-device copy + background writer — no step
+    # stall), records a v4 data cursor (epoch, step, plan key, partial
+    # accumulator) so auto_resume continues the interrupted epoch AT the
+    # snapshot step with zero batches replayed, and with peer_redundancy
+    # spills each process's shard bytes to its ring neighbor's directory so
+    # one lost host directory still restores.
     "guard": None,  # numerical guard block (resilience/guard.py): true, or
     # {max_consecutive_skips, audit_every_n_epochs, on_desync, max_rollbacks}.
     # Arms the in-step non-finite-gradient firewall (a poisoned update is a

@@ -72,7 +72,16 @@ execution provenance, training/step.py ``comm_overlap``): its
 collectives interleave with backward compute) or the barrier step and
 why. Null for writers with no gradient exchange (serving headers), but
 the KEY must exist — a reader must distinguish "barrier because overlap
-resolved off" from "predates the overlap mode".
+resolved off" from "predates the overlap mode";
+v11 added the required run_meta ``snapshot`` field (the async
+step-checkpoint engine, training/snapshot.py): an armed block carries
+the resolved config (``every_steps``/``async``/``inflight``/
+``peer_redundancy``) plus the writer's identity (prefix, process
+index), so a reader of a resumed history can tell which snapshot
+cadence produced the checkpoint family it restored from. ``false`` =
+the engine was off (epoch-granular checkpoints only); the KEY must
+exist — absence is drift, and a reader must distinguish "no step
+snapshots because the engine was off" from "predates the engine".
 Readers accept every version up to their own ``SCHEMA_VERSION`` and
 reject newer files; the per-version required-field sets apply at the
 version each record CARRIES, so a v2 history (no occupancy fields) stays
@@ -86,7 +95,7 @@ import hashlib
 import json
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 
 RECORD_TYPES = (
     "run_meta", "epoch", "step_stats", "event", "serving_stats",
@@ -248,6 +257,16 @@ _REQUIRED_SINCE = {
     10: {
         "run_meta": ("comm",),
     },
+    # v11: the async step-checkpoint engine's provenance (``snapshot``,
+    # training/snapshot.py). ``false`` for writers with the engine off (the
+    # default — epoch-granular checkpoints only) but the KEY must exist: a
+    # reader of a resumed history needs to distinguish "no step snapshots
+    # because the engine was off" from "this header predates step-granular
+    # checkpointing". An armed block names the cadence (every_steps), the
+    # writer mode (async/inflight) and peer-redundancy placement.
+    11: {
+        "run_meta": ("snapshot",),
+    },
 }
 
 def stamp(record_type: str, record: dict) -> dict:
@@ -283,6 +302,7 @@ def make_run_meta(
     tp_rules_hash: Optional[str] = None,
     tracing: Optional[dict] = None,
     comm: Optional[dict] = None,
+    snapshot=None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Build the run_meta header row from live run objects.
@@ -362,6 +382,10 @@ def make_run_meta(
         # segmented-backward ({enabled, segments}) or the barrier step and
         # why (null = no gradient exchange, e.g. serving headers)
         "comm": comm,
+        # required since schema v11: the async step-checkpoint engine's
+        # provenance — resolved config + writer identity when armed, False
+        # when off (epoch-granular checkpoints only)
+        "snapshot": False if snapshot is None else snapshot,
     }
     if extra:
         record.update(extra)

@@ -83,12 +83,17 @@ from tpuddp.resilience import faults, integrity
 
 logger = logging.getLogger("tpuddp")
 
-FORMAT_VERSION = 3  # v2 = topology record present (elastic resume);
+FORMAT_VERSION = 4  # v2 = topology record present (elastic resume);
 # v3 = the record additionally carries model_size + per-leaf mesh-axis
 # placement tags (the 2-D ("data", "model") mesh — ISSUE 14). v2 files keep
 # loading: readers key on record CONTENTS, and a v2 record written on a 2-D
 # mesh already names its mesh axes/shape, so the cross-model-width refusal
-# covers it too.
+# covers it too. v4 = the file MAY carry a ``__cursor__`` data-cursor record
+# (epoch, step, sampler epoch-plan key, partial metric accumulator) written
+# by step-granular snapshots — restore_latest resumes EXACTLY mid-epoch from
+# it instead of redoing the interrupted epoch. Cursor-less v4 files are
+# byte-compatible with v3; v3 readers never see the cursor (template
+# iteration skips dunder entries, like the meta/topology records).
 
 _KEY_MARK = "__prngkey__"
 _BF16_MARK = "__bf16__"  # npz can't serialize ml_dtypes natively (loads back
@@ -100,6 +105,15 @@ _META_MARK = "__meta__"  # scalar bookkeeping (epoch, completed flag) stored
 _TOPO_MARK = "__topology__"  # v2: one JSON record (world size, mesh axes, and
 # per-leaf shard tags for world-size-dependent leaves) — the metadata the
 # elastic reshard path needs; invisible to template iteration like the meta.
+_CURSOR_MARK = "__cursor__"  # v4: one JSON record — the DATA CURSOR of a
+# step-granular snapshot (epoch, step = real micro-batches applied, the
+# sampler epoch-plan key, and the names of the partial-accumulator arrays
+# stored under _CURSOR_ACC_MARK). Its presence marks a mid-epoch snapshot;
+# restore_latest surfaces it so the driver replays ZERO batches.
+_CURSOR_ACC_MARK = "__cursor_acc__"  # v4: the partial per-epoch metric
+# accumulator (e.g. {loss_sum, n} device fold) at the snapshot step, one
+# array per entry — seeding the resumed epoch's fold keeps the loss
+# trajectory bitwise-equal to an uninterrupted run.
 
 
 class TopologyMismatch(ValueError):
@@ -286,6 +300,8 @@ def save(
     tree: Any,
     meta: Optional[Dict[str, int]] = None,
     topology: Optional[dict] = None,
+    cursor: Optional[dict] = None,
+    cursor_acc: Optional[Any] = None,
 ) -> str:
     """Serialize a pytree to ``path`` (.npz). Caller handles rank gating.
     ``meta``: optional dict of int scalars (e.g. epoch, completed) stored as
@@ -293,8 +309,14 @@ def save(
     ``topology``: the v2 elastic record (see :func:`derive_topology`) —
     stored as a ``__topology__`` JSON entry whose presence marks the file
     format v2; None writes a v1-compatible file (no resharding story).
+    ``cursor``: the v4 data-cursor record of a step-granular snapshot
+    (JSON-able dict; see :mod:`tpuddp.training.snapshot`), with
+    ``cursor_acc`` the partial metric-accumulator pytree stored alongside it.
     A ``.sha256`` manifest sidecar is published after the data file so
-    ``latest()`` can verify integrity before trusting a checkpoint."""
+    ``latest()`` can verify integrity before trusting a checkpoint.
+    The publish is durable: the staged bytes are fsync'd before the atomic
+    rename, so a host crash right after ``save`` returns cannot leave a
+    checkpoint that is intact in the page cache but torn on disk."""
     flat = jax.tree_util.tree_flatten_with_path(tree)[0]
     payload = {}
     for p, leaf in flat:
@@ -311,14 +333,55 @@ def save(
         # for v1 files); the meta scalars stay exactly the v1 set so
         # pre-elastic readers of read_meta() see an unchanged contract
         payload[_TOPO_MARK] = np.asarray(json.dumps(topology))
+    if cursor is not None:
+        acc_payload = {}
+        if cursor_acc is not None:
+            for p, leaf in jax.tree_util.tree_flatten_with_path(cursor_acc)[0]:
+                k = _path_str(p)
+                if hasattr(leaf, "dtype") and leaf.dtype == ml_dtypes.bfloat16:
+                    acc_payload[_CURSOR_ACC_MARK + _BF16_MARK + k] = (
+                        np.asarray(leaf).view(np.uint16)
+                    )
+                else:
+                    acc_payload[_CURSOR_ACC_MARK + k] = np.asarray(leaf)
+        record = dict(cursor)
+        record["acc_keys"] = sorted(acc_payload)
+        payload[_CURSOR_MARK] = np.asarray(json.dumps(record, sort_keys=True))
+        for k in sorted(acc_payload):
+            payload[k] = acc_payload[k]
     for k, v in (meta or {}).items():
         payload[_META_MARK + k] = np.asarray(int(v), dtype=np.int64)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)  # atomic publish, no torn checkpoints
     integrity.write_manifest(path)
     return path
+
+
+def read_cursor(path: str) -> Optional[dict]:
+    """The v4 data-cursor record of a step-granular snapshot, with its
+    partial accumulator re-inflated under ``"acc"`` (a flat dict keyed by
+    the original pytree paths). None for epoch-granular / pre-v4 files."""
+    with np.load(path) as data:
+        if _CURSOR_MARK not in data.files:
+            return None
+        record = json.loads(str(np.asarray(data[_CURSOR_MARK]).item()))
+        acc: Dict[str, np.ndarray] = {}
+        for k in record.pop("acc_keys", []):
+            if k not in data.files:
+                continue
+            name = k[len(_CURSOR_ACC_MARK):]
+            if name.startswith(_BF16_MARK):
+                acc[name[len(_BF16_MARK):]] = np.asarray(data[k]).view(
+                    ml_dtypes.bfloat16
+                )
+            else:
+                acc[name] = np.asarray(data[k])
+        record["acc"] = acc or None
+        return record
 
 
 def read_meta(path: str) -> Dict[str, int]:
@@ -685,6 +748,39 @@ def checkpoint_path(save_dir: str, epoch: int, prefix: str = "ckpt") -> str:
     return os.path.join(save_dir, f"{prefix}_{epoch}.npz")
 
 
+def step_checkpoint_path(
+    save_dir: str, epoch: int, step: int, prefix: str = "ckpt"
+) -> str:
+    """``{prefix}_{epoch}_s{step}.npz`` — a STEP-granular snapshot taken
+    mid-epoch (``step`` real micro-batches of ``epoch`` applied). The suffix
+    is invisible to the pre-v4 ``{prefix}_{epoch}.npz`` listing regex, so
+    old readers simply never see step files."""
+    return os.path.join(save_dir, f"{prefix}_{epoch}_s{step}.npz")
+
+
+def peer_checkpoint_dirs(save_dir: str) -> List[str]:
+    """The peer-redundant spill directories reachable from ``save_dir``:
+    every ``ring_*`` subdirectory of ``<heartbeat_dir>/peer_ckpt``. Peer
+    redundancy rides the heartbeat channel's directory (the one filesystem
+    location every process of a multi-process job can already reach), each
+    process spilling its ring neighbor's snapshot bytes there — so the loss
+    of any single host's local checkpoint directory still yields a full
+    restore. Empty when no peer spills exist."""
+    from tpuddp.resilience import watchdog
+
+    hb = watchdog.heartbeat_dir(save_dir)
+    if not hb:
+        return []
+    root = os.path.join(hb, "peer_ckpt")
+    if not os.path.isdir(root):
+        return []
+    return sorted(
+        os.path.join(root, d)
+        for d in os.listdir(root)
+        if d.startswith("ring_") and os.path.isdir(os.path.join(root, d))
+    )
+
+
 def _gather_cross_host_shards(tree: Any) -> Any:
     """Materialize leaves that are sharded ACROSS hosts (weight-update-sharded
     optimizer moments: no single process holds the full vector) as host
@@ -713,6 +809,9 @@ def save_on_main(
     completed: bool = True,
     keep_last: Optional[int] = None,
     world_size: Optional[int] = None,
+    step: Optional[int] = None,
+    cursor: Optional[dict] = None,
+    cursor_acc: Optional[Any] = None,
 ) -> Optional[str]:
     """Process-0-only save + barrier — the reference's writer discipline
     (:217-223), with the cross-host shard gather (a collective) BEFORE the
@@ -724,39 +823,66 @@ def save_on_main(
     but the K newest epochs after a successful save. The v2 topology record
     is derived from the tree's live shardings BEFORE the cross-host gather
     (which flattens sharded leaves to host arrays); ``world_size`` supplies
-    the world when no sharding is inspectable."""
+    the world when no sharding is inspectable.
+
+    ``step`` (with an optional v4 ``cursor``/``cursor_acc``) writes a
+    STEP-granular mid-epoch file ``{prefix}_{epoch}_s{step}.npz`` instead —
+    a resumable-at-step snapshot (always ``completed=0``); ``restore_latest``
+    surfaces its cursor so the driver replays zero batches."""
     topology = derive_topology(tree, world_size)
     if jax.process_count() > 1:
         tree = _gather_cross_host_shards(tree)
     path = None
     if jax.process_index() == 0:
         os.makedirs(save_dir, exist_ok=True)
+        if step is None:
+            target = checkpoint_path(save_dir, epoch, prefix)
+            meta = {"epoch": epoch, "completed": int(completed)}
+        else:
+            target = step_checkpoint_path(save_dir, epoch, step, prefix)
+            meta = {"epoch": epoch, "completed": 0, "step": int(step)}
         path = save(
-            checkpoint_path(save_dir, epoch, prefix),
+            target,
             tree,
-            meta={"epoch": epoch, "completed": int(completed)},
+            meta=meta,
             topology=topology,
+            cursor=cursor,
+            cursor_acc=cursor_acc,
         )
         # chaos hook: corrupt@ckpt_N garbles the just-published file (stale
         # manifest included), which latest() must then detect and skip
-        faults.maybe_fire("ckpt", name=f"{prefix}_{epoch}", path=path)
+        name = os.path.basename(target)[: -len(".npz")]
+        faults.maybe_fire("ckpt", name=name, path=path)
         if keep_last is not None:
             prune_checkpoints(save_dir, keep_last, prefix)
     col.barrier("tpuddp_checkpoint")
     return path
 
 
-def _all_checkpoints(save_dir: str, prefix: str = "ckpt") -> List[Tuple[str, int]]:
-    """All ``(path, epoch)`` matches, newest epoch first."""
+def _family_key(epoch: int, step: Optional[int]) -> Tuple[int, int, int]:
+    """Total order over mixed step/epoch checkpoint families: newest first
+    by ``(epoch, family, step)``. A FULL-epoch file ``{prefix}_{e}.npz``
+    ranks newer than every step snapshot ``{prefix}_{e}_s*.npz`` of the same
+    epoch — any epoch-family write of epoch e (end-of-epoch save or a
+    preempt drain) happens after the last step snapshot of e."""
+    return (int(epoch), 1 if step is None else 0, 0 if step is None else int(step))
+
+
+def _all_checkpoints(
+    save_dir: str, prefix: str = "ckpt"
+) -> List[Tuple[str, int, Optional[int]]]:
+    """All ``(path, epoch, step)`` matches, newest first (``step`` is None
+    for epoch-granular files; ordering per :func:`_family_key`)."""
     if not os.path.isdir(save_dir):
         return []
-    pat = re.compile(rf"^{re.escape(prefix)}_(\d+)\.npz$")
+    pat = re.compile(rf"^{re.escape(prefix)}_(\d+)(?:_s(\d+))?\.npz$")
     found = []
     for name in os.listdir(save_dir):
         m = pat.match(name)
         if m:
-            found.append((os.path.join(save_dir, name), int(m.group(1))))
-    found.sort(key=lambda t: t[1], reverse=True)
+            step = int(m.group(2)) if m.group(2) is not None else None
+            found.append((os.path.join(save_dir, name), int(m.group(1)), step))
+    found.sort(key=lambda t: _family_key(t[1], t[2]), reverse=True)
     return found
 
 
@@ -765,14 +891,48 @@ def latest(save_dir: str, prefix: str = "ckpt") -> Optional[Tuple[str, int]]:
     resume helper the reference lacks (SURVEY.md §3.4). Candidates that fail
     integrity verification (manifest mismatch, truncation, a writer killed
     mid-``save``) are skipped with a warning in favor of the next-newest good
-    one — a corrupt newest checkpoint must not take down the resume path."""
-    for path, epoch in _all_checkpoints(save_dir, prefix):
+    one — a corrupt newest checkpoint must not take down the resume path.
+    Step snapshots participate in the ordering (see :func:`_family_key`);
+    use :func:`read_cursor` on the returned path to see whether it is one."""
+    for path, epoch, _step in _all_checkpoints(save_dir, prefix):
         if integrity.verify_file(path):
             return path, epoch
         logger.warning(
             "checkpoint %s failed integrity verification (corrupt or "
             "truncated); skipping it and falling back to the next-newest",
             path,
+        )
+    return None
+
+
+def _latest_any(
+    save_dir: str, prefix: str = "ckpt", include_peers: bool = True
+) -> Optional[Tuple[str, int, Optional[int], str]]:
+    """The freshest *intact* checkpoint across {local, peer, epoch-family}:
+    ``(path, epoch, step, provenance)``. Candidates from ``save_dir`` carry
+    provenance ``"local"``; candidates from the peer-redundant spill dirs
+    (see :func:`peer_checkpoint_dirs`) carry ``"peer:ring_<i>"``. Equal
+    freshness prefers local. Corrupt candidates are skipped with a warning —
+    that skip is exactly what the peer copies exist for."""
+    candidates = []
+    for path, epoch, step in _all_checkpoints(save_dir, prefix):
+        candidates.append((_family_key(epoch, step), 0, path, epoch, step, "local"))
+    if include_peers:
+        for pd in peer_checkpoint_dirs(save_dir):
+            prov = f"peer:{os.path.basename(pd)}"
+            for path, epoch, step in _all_checkpoints(pd, prefix):
+                candidates.append(
+                    (_family_key(epoch, step), 1, path, epoch, step, prov)
+                )
+    candidates.sort(key=lambda c: (c[0], -c[1]), reverse=True)
+    for _key, _rank, path, epoch, step, prov in candidates:
+        if integrity.verify_file(path):
+            return path, epoch, step, prov
+        logger.warning(
+            "checkpoint %s (%s) failed integrity verification (corrupt or "
+            "truncated); skipping it and falling back to the next-newest "
+            "intact candidate across {local, peer, epoch-family}",
+            path, prov,
         )
     return None
 
@@ -789,7 +949,7 @@ def sweep_stale_tmp(save_dir: str, prefix: str = "ckpt") -> int:
     if not os.path.isdir(save_dir):
         return 0
     pat = re.compile(
-        rf"^{re.escape(prefix)}_\d+\.npz(\.sha256)?\.tmp$"
+        rf"^{re.escape(prefix)}_\d+(_s\d+)?\.npz(\.sha256)?\.tmp$"
     )
     removed = 0
     for name in os.listdir(save_dir):
@@ -813,12 +973,28 @@ def sweep_stale_tmp(save_dir: str, prefix: str = "ckpt") -> int:
 def prune_checkpoints(save_dir: str, keep_last: int, prefix: str = "ckpt") -> int:
     """Delete all but the ``keep_last`` newest ``{prefix}_*.npz`` (and their
     manifests), plus any stale ``.tmp`` staging orphans. Returns the number
-    of checkpoints removed."""
+    of checkpoints removed.
+
+    Ordering is by ``(epoch, step)`` across MIXED step/epoch families (see
+    :func:`_family_key`) — a burst of step snapshots must age out by recency,
+    not by name family. One hard floor: the newest INTACT full-epoch
+    checkpoint is never collected, even when ``keep_last`` step snapshots
+    outrank it — it is the only epoch-granular fallback left if every newer
+    step snapshot turns out corrupt, and step snapshots of a partially
+    applied epoch are useless to pre-v4 tooling."""
     if keep_last < 1:
         raise ValueError(f"keep_last must be >= 1, got {keep_last}")
     sweep_stale_tmp(save_dir, prefix)
+    all_ckpts = _all_checkpoints(save_dir, prefix)
+    keep = {path for path, _e, _s in all_ckpts[:keep_last]}
+    for path, _epoch, step in all_ckpts:
+        if step is None and integrity.verify_file(path):
+            keep.add(path)  # newest intact full-epoch file: never collected
+            break
     removed = 0
-    for path, _epoch in _all_checkpoints(save_dir, prefix)[keep_last:]:
+    for path, _epoch, _step in all_ckpts:
+        if path in keep:
+            continue
         for p in (path, integrity.manifest_path(path)):
             try:
                 os.remove(p)
@@ -837,12 +1013,22 @@ def restore_latest(
     reshard_log: Optional[List[dict]] = None,
     model_size: Optional[int] = None,
     reshard_on_mismatch: bool = False,
+    cursor_out: Optional[List[dict]] = None,
 ) -> Tuple[Any, int]:
     """Load the newest intact checkpoint into ``like``'s structure. Returns
     ``(tree, next_epoch)``; ``(like, 0)`` when none exists. An emergency save
     (``completed=0`` meta, written during a preemption drain) yields its own
     epoch as ``next_epoch`` so the interrupted epoch is redone from the saved
     mid-epoch state; end-of-epoch saves yield ``epoch + 1``.
+
+    Candidate selection spans {local, peer, epoch-family}: step-granular v4
+    snapshots and peer-redundant spill copies (see
+    :func:`peer_checkpoint_dirs`) compete with local epoch files on
+    ``(epoch, step)`` freshness, freshest-INTACT wins, and the provenance of
+    the pick is logged. A v4 step snapshot yields its cursor's epoch as
+    ``next_epoch`` and appends the cursor (plus ``path``/``provenance``) to
+    ``cursor_out`` — the driver then resumes EXACTLY at the recorded step,
+    replaying zero batches, instead of redoing the epoch.
 
     Elastic resume: ``world_size`` is the CURRENT world; a v2 checkpoint
     written on a different world is resharded onto it (see :func:`load`).
@@ -856,10 +1042,18 @@ def restore_latest(
     ``comm_state_reset`` per residual that had to reset (M∤N) — so the
     epoch driver can land them as event rows in history.jsonl."""
     sweep_stale_tmp(save_dir, prefix)
-    found = latest(save_dir, prefix)
+    found = _latest_any(save_dir, prefix)
     if found is None:
         return like, 0
-    path, epoch = found
+    path, epoch, step, provenance = found
+    if provenance != "local" or step is not None:
+        logger.warning(
+            "restore_latest: picked %s (epoch=%d, %s, provenance=%s) as the "
+            "freshest intact candidate across {local, peer, epoch-family}",
+            path, epoch,
+            "full-epoch" if step is None else f"step={step}",
+            provenance,
+        )
     actions: List[dict] = []
     tree, topo = load_with_topology(
         path, like, world_size=world_size, reshard_actions=actions,
@@ -871,6 +1065,29 @@ def restore_latest(
                 path, epoch, topo, world_size, actions, model_size=model_size
             )
         )
+    cursor = read_cursor(path)
+    if cursor is not None:
+        if actions:
+            # a cross-topology reshard changes the data order (the sampler
+            # plan keys on the world size), so the step cursor no longer
+            # addresses the same batches — surface it, but poison the plan
+            # key so the driver falls back to redoing the epoch from the
+            # restored mid-epoch state instead of skipping wrong batches
+            cursor = dict(cursor)
+            cursor["plan_key"] = None
+        if cursor_out is not None:
+            entry = dict(cursor)
+            entry["path"] = path
+            entry["provenance"] = provenance
+            cursor_out.append(entry)
+        logger.warning(
+            "resuming from STEP snapshot %s (epoch %d, step %s, "
+            "provenance=%s); the interrupted epoch continues at the recorded "
+            "step — zero batches replayed",
+            path, int(cursor.get("epoch", epoch)), cursor.get("step"),
+            provenance,
+        )
+        return tree, int(cursor.get("epoch", epoch))
     meta = read_meta(path)
     if not meta.get("completed", 1):
         logger.warning(

@@ -46,6 +46,7 @@ from tpuddp.observability import (
 )
 from tpuddp.training import checkpoint as ckpt
 from tpuddp.training import pipeline as pipeline_lib
+from tpuddp.training import snapshot as snapshot_lib
 from tpuddp.utils import batching
 from tpuddp.training.step import finalize_metrics
 
@@ -114,7 +115,8 @@ def _fused_pass(
     ddp, state, loader, scan_k: int, step_one, step_many, probe_cb=None,
     accum: int = 1, poll=preemption_requested, inject_cb=None, tel=None,
     pipeline: Optional[pipeline_lib.PipelineConfig] = None,
-    tracer=None, trace_parent=None, comm_attrs=None,
+    tracer=None, trace_parent=None, comm_attrs=None, snap_cb=None,
+    init_acc=None,
 ):
     """One pass over ``loader`` — the async pipelined runner
     (:mod:`tpuddp.training.pipeline`): K-fused dispatch, a ``depth``-chunk
@@ -128,7 +130,7 @@ def _fused_pass(
         cfg=pipeline if pipeline is not None else pipeline_lib.DEFAULT,
         probe_cb=probe_cb, accum=accum, poll=poll, inject_cb=inject_cb,
         tel=tel, tracer=tracer, trace_parent=trace_parent,
-        comm_attrs=comm_attrs,
+        comm_attrs=comm_attrs, snap_cb=snap_cb, init_acc=init_acc,
     )
 
 
@@ -153,6 +155,7 @@ def run_training_loop(
     run_meta: Optional[dict] = None,
     pipeline=None,
     observability=None,
+    snapshot=None,
     log=print,
 ):
     """Run the full training loop; returns ``(state, history)`` where history
@@ -208,6 +211,19 @@ def run_training_loop(
     detector, and a crash flight recorder dumped on abnormal exits. All
     host-side: the compiled step, the fence cadence, and the HLO are
     untouched with the whole plane on.
+
+    Async step snapshots (``snapshot``, the ``training.snapshot`` block —
+    :mod:`tpuddp.training.snapshot`): a background checkpoint engine takes
+    device snapshots every N real micro-batches between dispatches (no step
+    stall, no HLO change) and records the v4 data cursor. A preempt drain
+    then flushes the in-flight snapshot and writes a final step delta
+    instead of re-serializing the whole state; auto-resume from a cursor-
+    bearing snapshot continues the interrupted epoch AT the recorded step —
+    zero batches replayed, loss trajectory bitwise-equal to an
+    uninterrupted same-seed run — and guard rollbacks restore to the last
+    good STEP, not epoch. Off (None) keeps the pre-v4 epoch-granular
+    contract, including redo-the-interrupted-epoch (deprecated — see README
+    "Async checkpointing & exact resume").
     """
     from tpuddp import config as cfg_lib
     from tpuddp.observability import aggregate as agg_lib
@@ -275,6 +291,20 @@ def run_training_loop(
             # process died in, not just the last flushed window
             flight.add_context("open_spans", tracer.open_span_summaries)
     metrics_writer = MetricsWriter(save_dir, flight=flight)
+    # ---- async step-granular snapshots (training/snapshot.py): the engine
+    # copies state on-device between dispatches and serializes on a
+    # background writer; pending_cursor carries a restored v4 data cursor to
+    # the epoch that consumes it (exact mid-epoch resume, zero replay).
+    snap_cfg = snapshot_lib.resolve_snapshot(snapshot)
+    snap_engine = None
+    if snap_cfg.enabled and save_dir is not None:
+        snap_engine = snapshot_lib.SnapshotEngine(
+            save_dir, snap_cfg,
+            world_size=getattr(ddp, "world_size", None),
+            keep_last=keep_last,
+            tracer=tracer, flight=flight,
+        )
+    pending_cursor = {"c": None}
     # the run's ONE trace id: minted before the restore below so an elastic
     # reshard episode lands as a named span in the SAME trace as the epochs
     # it precedes — the tracing plane shows recovery, not a gap
@@ -291,13 +321,29 @@ def run_training_loop(
             "auto-resume restore", trace_lib.KIND_ACTION,
             trace_id=run_trace_id, tid="train",
         )
+        resume_cursor = []
         state, resumed = ckpt.restore_latest(
             save_dir, state,
             world_size=getattr(ddp, "world_size", None),
             model_size=getattr(ddp, "model_size", None),
             reshard_log=reshard_log,
             reshard_on_mismatch=reshard_on_mismatch,
+            cursor_out=resume_cursor,
         )
+        if resume_cursor:
+            # a v4 step snapshot: the cursor's epoch resumes AT its step
+            # (the epoch below that consumes pending_cursor verifies the
+            # plan key first — a changed data order falls back to redo)
+            pending_cursor["c"] = resume_cursor[-1]
+            if flight is not None:
+                flight.note(snapshot_resume={
+                    "epoch": resume_cursor[-1].get("epoch"),
+                    "step": resume_cursor[-1].get("step"),
+                    "provenance": resume_cursor[-1].get("provenance"),
+                    "path": os.path.basename(
+                        resume_cursor[-1].get("path") or ""
+                    ),
+                })
         if resumed > start_epoch:
             start_epoch = resumed
             if is_main:
@@ -422,6 +468,12 @@ def run_training_loop(
         tp_rules_hash=getattr(ddp, "tp_rules_hash", None),
         # v9 tracing block: ring capacity + artifact name (null = off)
         tracing=tracer.describe(),
+        # v11 snapshot block: async step-checkpoint engine provenance
+        # (config + writer identity), or False when the engine is off
+        snapshot=(
+            snap_engine.describe() if snap_engine is not None
+            else (snap_cfg.as_dict() if snap_cfg.enabled else False)
+        ),
         comm=comm_block,
         extra=meta_extra,
     ))
@@ -489,8 +541,12 @@ def run_training_loop(
         """Restore the newest integrity-verified checkpoint and hand back
         ``(state, epoch_to_redo)``. The caller re-enters the epoch loop
         there, so ``set_epoch`` re-derives the redone epoch's data order.
-        The rollback is a recorded event in history.jsonl, and a bounded one
-        — replaying a persistently-poisoned epoch forever is not recovery."""
+        With step snapshots armed the newest checkpoint is usually a v4
+        STEP snapshot — the rollback then lands on the last good STEP, not
+        epoch: its cursor goes through ``pending_cursor`` and the redone
+        epoch continues at the recorded step. The rollback is a recorded
+        event in history.jsonl, and a bounded one — replaying a
+        persistently-poisoned epoch forever is not recovery."""
         rollback_count["n"] += 1
         if rollback_count["n"] > guard_cfg.max_rollbacks:
             raise RuntimeError(
@@ -499,17 +555,24 @@ def run_training_loop(
                 "known-good state — a systematic divergence, not a transient."
             )
         rb_log = []
+        rb_cursor = []
         restored, redo_epoch = ckpt.restore_latest(
             save_dir, cur_state,
             world_size=getattr(ddp, "world_size", None),
             model_size=getattr(ddp, "model_size", None),
             reshard_log=rb_log,
             reshard_on_mismatch=reshard_on_mismatch,
+            cursor_out=rb_cursor,
         )
+        resume_step = None
+        if rb_cursor:
+            pending_cursor["c"] = rb_cursor[-1]
+            resume_step = rb_cursor[-1].get("step")
         metrics_writer.write(stamp("event", {
             "event": "rollback",
             "epoch": epoch,
             "resume_epoch": redo_epoch,
+            "resume_step": resume_step,
             "reason": reason,
         }))
         for ev in rb_log:
@@ -519,6 +582,11 @@ def run_training_loop(
                 f"Guard rollback ({reason}): restored last-good checkpoint, "
                 f"redoing from epoch {redo_epoch}."
             )
+            if resume_step is not None:
+                log(
+                    f"Rollback target is a step snapshot: epoch {redo_epoch} "
+                    f"continues at step {resume_step}."
+                )
         return restored, redo_epoch
 
     def can_roll_back() -> bool:
@@ -557,39 +625,73 @@ def run_training_loop(
         # the decision; this broadcast is one tiny per-epoch collective.
         return bool(col.broadcast_one_to_all(np.asarray(preemption_requested())))
 
-    def emergency_stop(epoch, completed=False):
+    def emergency_stop(epoch, completed=False, partial=None):
         """Preemption drain: one atomic full-state save, then the distinct
         exit path via TrainingPreempted. ``completed=False`` (the default)
         marks a mid-train-pass drain — resume redoes ``epoch`` from the saved
         state. ``completed=True`` is the eval-pass interruption: every
         optimizer update of ``epoch`` is already applied, so the save counts
         as end-of-epoch and resume starts at ``epoch + 1`` (re-training it
-        would double-apply the whole epoch); only its eval metrics are lost."""
+        would double-apply the whole epoch); only its eval metrics are lost.
+
+        With the snapshot engine armed, a mid-train-pass drain (``partial``:
+        the epoch's progress dict + partial accumulator) reuses the async
+        writer's flush path instead of re-serializing from scratch: flush
+        the in-flight snapshot (work already done), then write only the
+        final step delta. Resume then continues AT the drained step."""
         path = None
+        flushed_step = None
+        snap_drain = (
+            snap_engine is not None and not completed and partial is not None
+        )
         if save_dir is not None:
-            path = ckpt.save_on_main(
-                save_dir, epoch, state, completed=completed,
-                world_size=getattr(ddp, "world_size", None),
-            )
-            if is_main:
-                log(f"Preempted: emergency checkpoint for epoch {epoch} saved.")
+            if snap_drain:
+                flushed_step = snap_engine.flush()
+                path = snap_engine.final_snapshot(
+                    state, epoch=epoch, step=int(partial["step"]),
+                    plan_key=partial.get("plan_key"), acc=partial.get("acc"),
+                )
+            if path is None:
+                snap_drain = False
+                path = ckpt.save_on_main(
+                    save_dir, epoch, state, completed=completed,
+                    world_size=getattr(ddp, "world_size", None),
+                )
+                if is_main:
+                    log(f"Preempted: emergency checkpoint for epoch {epoch} saved.")
+            elif is_main:
+                log(
+                    f"Preempted: drained snapshot writer (flushed step "
+                    f"{flushed_step}) and saved final step snapshot for "
+                    f"epoch {epoch} step {int(partial['step'])}."
+                )
         # the drain's event row, fsync'd NOW: the SIGKILL that follows the
         # grace window must not be able to eat the post-mortem record
-        metrics_writer.write(stamp("event", {
+        event = {
             "event": "preempt",
             "epoch": epoch,
             "completed": bool(completed),
             "step": tel.recorder.global_step,
-        }))
+        }
+        if snap_drain:
+            event["snapshot_step"] = int(partial["step"])
+        metrics_writer.write(stamp("event", event))
         metrics_writer.sync()
         # the exit-75 flight recording: the writer tee above means the
         # preempt event (and the last windows before it) are in the ring
         if flight is not None:
-            flight.note(
+            notes = dict(
                 emergency_checkpoint=path,
                 emergency_epoch=epoch,
                 emergency_step=tel.recorder.global_step,
             )
+            if snap_drain:
+                # the chaos contract: the recording NAMES the flushed step
+                # (the last snapshot the writer published before the final
+                # delta) and the final step the drain itself wrote
+                notes["snapshot_flushed_step"] = flushed_step
+                notes["snapshot_final_step"] = int(partial["step"])
+            flight.note(**notes)
             flight.dump("preempt")
         raise TrainingPreempted(epoch, path)
 
@@ -693,6 +795,72 @@ def run_training_loop(
             if print_rand:
                 log(f"Process {jax.process_index()}, {seeding.rng_probe_string()}")
 
+            # ---- exact mid-epoch resume: a v4 cursor restored for THIS epoch
+            # skips the already-applied prefix of the batch plan (zero batches
+            # replayed) instead of redoing the epoch. The cursor's plan key
+            # must match what this loader would produce for this epoch — a
+            # mismatch (different sampler config, resharded data order) falls
+            # back to the legacy redo-the-epoch path. ----
+            resume_skip = None
+            cur = pending_cursor["c"]
+            if cur is not None and int(cur.get("epoch", -1)) == epoch:
+                pending_cursor["c"] = None
+                if cur.get("plan_key"):
+                    expect = snapshot_lib.epoch_plan_key(train_loader, epoch)
+                    if cur["plan_key"] == expect:
+                        resume_skip = cur
+                        if is_main:
+                            log(
+                                f"Exact resume: epoch {epoch} continues at "
+                                f"step {int(cur['step'])} (zero batches "
+                                f"replayed)."
+                            )
+                    else:
+                        logger.warning(
+                            "Step snapshot plan key mismatch for epoch %d "
+                            "(%s != %s): data order changed, redoing the "
+                            "epoch from the restored state.",
+                            epoch, cur["plan_key"], expect,
+                        )
+                else:
+                    logger.warning(
+                        "Step snapshot for epoch %d carries no plan key "
+                        "(resharded restore): redoing the epoch.", epoch,
+                    )
+            elif cur is not None and int(cur.get("epoch", -1)) != epoch:
+                pending_cursor["c"] = None
+
+            base_step = int(resume_skip["step"]) if resume_skip else 0
+            pass_loader = train_loader
+            init_acc = None
+            if base_step > 0:
+                pass_loader = snapshot_lib.EpochTailLoader(
+                    train_loader, base_step
+                )
+                init_acc = snapshot_lib.acc_from_cursor(resume_skip)
+
+            # snapshot engine arming for this epoch: the snap_cb fires between
+            # step dispatches (post-dispatch, pre-next-stage) so the staged
+            # queue never drains — the snapshot is an async on-device copy,
+            # serialized off-thread.
+            snap_cb = None
+            epoch_prog = None
+            if snap_engine is not None:
+                plan_key = snapshot_lib.epoch_plan_key(train_loader, epoch)
+                epoch_prog = {
+                    "epoch": epoch, "step": base_step, "plan_key": plan_key,
+                }
+                snap_engine.begin_epoch(epoch, base_step)
+                snap_engine.trace_parent = epoch_span
+
+                def snap_cb(st, batches_done, drain, _base=base_step,
+                            _ep=epoch, _pk=plan_key, _prog=epoch_prog):
+                    _prog["step"] = _base + batches_done
+                    snap_engine.maybe(
+                        st, epoch=_ep, step=_base + batches_done,
+                        plan_key=_pk, drain=drain,
+                    )
+
             # ---- train pass (hot loop: one jitted step per batch, or per
             # `scan_steps` batches fused into a single lax.scan dispatch) ----
             def train_probe(batch_idx, host_batch):
@@ -702,14 +870,20 @@ def run_training_loop(
                         log(f"TRAIN: Batch {batch_idx}, Data {probe(host_batch[0])}")
 
             state, train_acc, interrupted = _fused_pass(
-                ddp, state, train_loader, scan_steps,
+                ddp, state, pass_loader, scan_steps,
                 ddp.train_step, ddp.train_step_many, probe_cb=train_probe,
                 accum=accum, poll=poll, inject_cb=nan_inject, tel=tel,
                 pipeline=pipeline, tracer=tracer, trace_parent=epoch_span,
-                comm_attrs=comm_attrs,
+                comm_attrs=comm_attrs, snap_cb=snap_cb, init_acc=init_acc,
             )
             if interrupted:
-                emergency_stop(epoch)
+                emergency_stop(
+                    epoch,
+                    partial=(
+                        {**epoch_prog, "acc": train_acc}
+                        if epoch_prog is not None else None
+                    ),
+                )
 
             # ---- eval pass (same K-fused dispatch + upload lookahead; without
             # it the eval epoch is per-batch dispatch-bound). State threads
@@ -943,6 +1117,8 @@ def run_training_loop(
         # not lose the trace — it is the post-mortem artifact — nor leave the
         # JSONL metrics record unflushed/truncated. The live plane tears
         # down too: endpoint closed, flight ring deregistered.
+        if snap_engine is not None:
+            snap_engine.close()
         tel.finish()
         stop_profiler()
         if tracer.enabled:

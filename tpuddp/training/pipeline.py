@@ -228,7 +228,7 @@ def run_pass(
     ddp, state, loader, scan_k: int, step_one, step_many, *,
     cfg: PipelineConfig = DEFAULT, probe_cb=None, accum: int = 1,
     poll=_never, inject_cb=None, tel=None, tracer=None, trace_parent=None,
-    comm_attrs=None,
+    comm_attrs=None, snap_cb=None, init_acc=None,
 ):
     """One pipelined pass over ``loader``: K-fused dispatch with a
     ``cfg.depth``-chunk staged device queue and a deferred readback drain.
@@ -259,6 +259,18 @@ def run_pass(
     bracketing of calls this pass already makes: no new fences, bitwise
     identity untouched.
 
+    Step snapshots (``snap_cb``, the async checkpoint engine's hook): called
+    between dispatches — AFTER dispatch N's telemetry posts and BEFORE
+    dispatch N+1 is issued — with ``(state, real_batches_dispatched,
+    drain)``. "Real" excludes the all-padding micro-batches a ragged tail
+    stages, so the count addresses actual loader positions. The hook is
+    host-side bookkeeping plus async device copies: it must never block
+    (the engine skips when its writer queue is full), so the staged queue
+    never drains and bitwise identity/HLO are untouched. ``init_acc`` seeds
+    the readback drain's accumulator — a resumed mid-epoch pass passes the
+    cursor's partial fold so the epoch total equals an uninterrupted run's,
+    bitwise.
+
     Returns ``(state, accumulated_metrics, interrupted)``.
     """
     if tel is None:
@@ -270,12 +282,15 @@ def run_pass(
         (getattr(loader, "batch_nbytes", None) or 0) * max(1, scan_k) or None,
     )
     drain = _ReadbackDrain()
+    if init_acc is not None:
+        drain.acc = init_acc
     stall = StallClock()
-    staged = deque()  # (staged_chunk, n_steps, n_samples, use_many)
+    staged = deque()  # (staged_chunk, n_steps, n_real, n_samples, use_many)
+    dispatched_real = 0  # real (non-padding) micro-batches dispatched so far
 
     def dispatch_oldest():
-        nonlocal state
-        chunk, n_steps, n_samples, use_many = staged.popleft()
+        nonlocal state, dispatched_real
+        chunk, n_steps, n_real, n_samples, use_many = staged.popleft()
         tel.pre_dispatch(n_steps)
         dsp = tracer.start_span(
             "dispatch", trace_lib.KIND_DISPATCH, parent=trace_parent,
@@ -326,13 +341,18 @@ def run_pass(
             staging_depth=len(staged),
             inflight_depth=drain.inflight,
         )
+        dispatched_real += n_real
+        if snap_cb is not None:
+            # step-boundary snapshot hook: after this dispatch's telemetry,
+            # before the next dispatch — never blocking (see docstring)
+            snap_cb(state, dispatched_real, drain)
 
-    def stage(chunk_value, n_steps, n_samples, use_many):
+    def stage(chunk_value, n_steps, n_real, n_samples, use_many):
         ssp = tracer.start_span(
             "stage", trace_lib.KIND_STAGE, parent=trace_parent,
             attrs={"steps": n_steps},
         )
-        staged.append((chunk_value(), n_steps, n_samples, use_many))
+        staged.append((chunk_value(), n_steps, n_real, n_samples, use_many))
         tracer.end_span(ssp)
 
     def drain_all():
@@ -358,7 +378,7 @@ def run_pass(
             # placement with batch N's dispatch (the pre-pipeline path staged
             # nothing ahead here and paid the transfer serially). Same depth
             # semantics as the scan path: `depth` batches held staged ahead.
-            stage(lambda: ddp.shard(host_batch), 1, len(host_batch[1]), False)
+            stage(lambda: ddp.shard(host_batch), 1, 1, len(host_batch[1]), False)
             while len(staged) > depth or (staged and cfg.sync_readback):
                 dispatch_oldest()
             continue
@@ -366,6 +386,7 @@ def run_pass(
         if len(chunk) == scan_k:
             stage(
                 lambda c=chunk: ddp.shard_stacked(stack_batches(c)),
+                scan_k,
                 scan_k,
                 sum(len(b[1]) for b in chunk),
                 True,
@@ -383,16 +404,17 @@ def run_pass(
         # tail under accumulation: pad to whole cycles, one scan dispatch
         # (a per-batch step would fire a full-scale update per micro-batch)
         tail_samples = sum(len(b[1]) for b in chunk)
+        n_real_tail = len(chunk)  # padding batches are not loader positions
         tail = _pad_to_cycles(chunk, accum)
         stage(
             lambda: ddp.shard_stacked(stack_batches(tail)),
-            len(tail), tail_samples, True,
+            len(tail), n_real_tail, tail_samples, True,
         )
         dispatch_oldest()
         return state, drain_all(), poll()
     for host_batch in chunk:  # remainder: single steps, same semantics
         if poll():
             return state, drain_all(), True
-        stage(lambda: ddp.shard(host_batch), 1, len(host_batch[1]), False)
+        stage(lambda: ddp.shard(host_batch), 1, 1, len(host_batch[1]), False)
         dispatch_oldest()
     return state, drain_all(), poll()

@@ -71,8 +71,11 @@ KEY_MARK = "__prngkey__"
 BF16_MARK = "__bf16__"
 META_MARK = "__meta__"
 TOPO_MARK = "__topology__"
+CURSOR_MARK = "__cursor"  # prefix of both __cursor__ and __cursor_acc__*
 
-FORMAT_VERSION = 3
+FORMAT_VERSION = 4  # tracks checkpoint.FORMAT_VERSION — v4 adds the
+# optional __cursor__ data-cursor record; the topology record contents the
+# reshaper keys on are unchanged from v3.
 
 _MODEL_AXIS = "model"
 _DATA_AXIS = "data"
@@ -339,7 +342,11 @@ def reshard_arrays(
     bare: Dict[str, Tuple[str, np.ndarray]] = {}
     passthrough: Dict[str, np.ndarray] = {}
     for k, v in stored.items():
-        if k == TOPO_MARK or k.startswith(META_MARK):
+        if k == TOPO_MARK or k.startswith(META_MARK) or k.startswith(CURSOR_MARK):
+            # the v4 data cursor (and its accumulator arrays) is bookkeeping,
+            # not model state — it passes through unreshaped; restore_latest
+            # poisons a resharded cursor's plan key so the driver redoes the
+            # epoch instead of skipping wrong batches
             passthrough[k] = v
             continue
         mark, bk = _strip_mark(k)

@@ -1,0 +1,572 @@
+"""Async step-granular checkpointing: device snapshots between dispatches,
+a background writer, and the v4 data cursor for EXACT mid-epoch resume.
+
+Every recovery path in the stack — preempt drain, guard rollback, elastic
+resume, mesh reshard — used to bottom out on synchronous epoch-granular
+``save_on_main``: a fault at step N of a long epoch lost the whole epoch,
+and the save itself stalled the async pipeline for the full
+serialize+fsync. This module removes both costs:
+
+**Step-boundary device snapshot.** :meth:`SnapshotEngine.maybe` runs in the
+dispatch loop between step dispatches. It folds the pipeline's pending
+metric readbacks device-side (no host sync), takes a cheap on-device copy
+of the ``TrainState`` + partial accumulator (``jnp.copy`` per leaf — an
+async device-to-device dispatch that survives the donation of the original
+buffers by the next step), and hands the copy to a bounded queue. The
+staged queue never drains and the host never blocks: when the queue is
+full the snapshot is SKIPPED (counted, not waited for). The step loop pays
+only the enqueue — the snapshot span + ``host_stall`` accounting prove it.
+
+**Background writer.** A daemon thread dequeues snapshots and serializes
+them through the exact same :func:`tpuddp.training.checkpoint.save` path a
+synchronous save takes — tmp + fsync + atomic rename + ``.sha256``
+manifest — so an async snapshot of step N is byte-identical on disk to a
+synchronous save of the same step (proven by test). Writer statistics
+(snapshots written, queue-full skips, write seconds, bytes) land in a
+``.writer.json`` sidecar next to each snapshot — deliberately OUTSIDE the
+snapshot payload, which must stay mode-independent for byte identity.
+
+**The v4 data cursor.** Each snapshot records ``(epoch, step, sampler
+epoch-plan key)`` plus the partial metric accumulator in the checkpoint's
+``__cursor__`` record. ``restore_latest`` surfaces it; the driver then
+recomputes the plan key for the restored epoch (:func:`epoch_plan_key` —
+a fingerprint of everything that determines the epoch's batch order) and,
+on a match, resumes the epoch AT the recorded step via
+:class:`EpochTailLoader` (random access through ``make_batch_plan`` — zero
+batches replayed) with the accumulator fold seeded from the cursor. The
+resumed loss trajectory is bitwise-equal to an uninterrupted same-seed
+run. A plan-key mismatch (e.g. an elastic world resize changed the batch
+order) falls back to the pre-v4 contract: redo the epoch from the restored
+mid-epoch state.
+
+**Peer-redundant placement.** With ``peer_redundancy`` on, each writing
+process additionally spills its ring neighbor's snapshot bytes (payload +
+manifest) under ``<heartbeat_dir>/peer_ckpt/ring_<i>`` — the heartbeat
+channel's directory, the one filesystem location every process already
+shares. ``restore_latest`` considers peer spills alongside local files,
+freshest-intact wins, and logs the provenance — so losing any single
+host's checkpoint directory still yields a full restore.
+
+Config block (``training.snapshot``, unknown keys refused)::
+
+    snapshot:
+      every_steps: 50        # snapshot cadence in real micro-batches; 0=off
+      async: true            # background writer (false = inline, for tests)
+      inflight: 2            # bounded writer queue depth; full => skip
+      peer_redundancy: false # spill ring-neighbor copies via heartbeat dir
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import queue
+import re
+import shutil
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuddp.observability import trace as trace_lib
+from tpuddp.resilience import faults, integrity
+from tpuddp.training import checkpoint as ckpt
+
+logger = logging.getLogger("tpuddp")
+
+SNAPSHOT_DEFAULTS: Dict[str, Any] = {
+    "every_steps": 50,
+    "async": True,
+    "inflight": 2,
+    "peer_redundancy": False,
+}
+
+
+@dataclass(frozen=True)
+class SnapshotConfig:
+    """Resolved ``training.snapshot`` block. ``every_steps == 0`` means the
+    engine is off (the default: ``snapshot: null``). The config KEY is
+    ``async`` (a Python keyword, hence the field name)."""
+
+    every_steps: int = 0
+    async_writes: bool = True
+    inflight: int = 2
+    peer_redundancy: bool = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.every_steps > 0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "every_steps": self.every_steps,
+            "async": self.async_writes,
+            "inflight": self.inflight,
+            "peer_redundancy": self.peer_redundancy,
+        }
+
+
+OFF = SnapshotConfig()
+
+
+def resolve_snapshot(block) -> SnapshotConfig:
+    """``training.snapshot`` -> :class:`SnapshotConfig`. None/False = off;
+    True = all defaults; a mapping merges over :data:`SNAPSHOT_DEFAULTS`
+    with unknown-key refusal (the config contract every block follows)."""
+    if isinstance(block, SnapshotConfig):
+        return block
+    if block is None or block is False:
+        return OFF
+    if block is True:
+        block = {}
+    if not isinstance(block, dict):
+        raise ValueError(
+            "training.snapshot must be a mapping (or true/false), got "
+            f"{type(block).__name__}"
+        )
+    from tpuddp.config import _merge_refusing_unknown
+
+    cfg = _merge_refusing_unknown(SNAPSHOT_DEFAULTS, block, "training.snapshot")
+    every = int(cfg["every_steps"])
+    if every < 0:
+        raise ValueError(
+            f"training.snapshot.every_steps must be >= 0, got {every}"
+        )
+    inflight = int(cfg["inflight"])
+    if inflight < 1:
+        raise ValueError(
+            f"training.snapshot.inflight must be >= 1, got {inflight}"
+        )
+    return SnapshotConfig(
+        every_steps=every,
+        async_writes=bool(cfg["async"]),
+        inflight=inflight,
+        peer_redundancy=bool(cfg["peer_redundancy"]),
+    )
+
+
+# ---------------------------------------------------------------- cursor --
+
+
+def epoch_plan_key(loader, epoch: int) -> str:
+    """Fingerprint of everything that determines ``loader``'s batch order
+    for ``epoch``: loader class, length, batch size, seed, shuffle, world
+    layout, and the epoch itself. Two runs with equal keys fetch identical
+    batches at identical steps (``make_batch_plan`` random access is a pure
+    function of exactly these), so a v4 cursor whose recorded key matches
+    the restored run's recomputed key can skip the applied prefix without
+    replaying or re-fetching a single batch. An elastic world resize, a
+    different seed, or a different dataset all change the key — the driver
+    then falls back to redoing the epoch."""
+    inner = loader
+    hops = 0
+    while hops < 4:  # Prefetch/Tail/test delegating wrappers
+        nxt = inner.__dict__.get("loader", inner.__dict__.get("inner"))
+        if nxt is None:
+            break
+        inner = nxt
+        hops += 1
+    fields: Dict[str, Any] = {
+        "loader": type(inner).__name__,
+        "n_batches": len(loader),
+        "batch_size": getattr(inner, "batch_size", None),
+        "seed": getattr(inner, "seed", None),
+        "shuffle": getattr(inner, "shuffle", None),
+        "drop_last": getattr(inner, "drop_last", None),
+        "world_size": getattr(inner, "world_size", None),
+        "epoch": int(epoch),
+    }
+    local_ranks = getattr(inner, "local_ranks", None)
+    if local_ranks is not None:
+        fields["local_ranks"] = [int(r) for r in local_ranks]
+    samplers = getattr(inner, "samplers", None)
+    if samplers:
+        s0 = samplers[0]
+        fields["seed"] = getattr(s0, "seed", fields["seed"])
+        fields["shuffle"] = getattr(s0, "shuffle", fields["shuffle"])
+    canon = json.dumps(fields, sort_keys=True, default=str)
+    return hashlib.sha256(canon.encode("utf-8")).hexdigest()[:16]
+
+
+_KEYSTR_RE = re.compile(r"^\['([^']*)'\]$")
+
+
+def acc_from_cursor(cursor: Optional[dict]) -> Optional[Dict[str, np.ndarray]]:
+    """The cursor's partial accumulator as a plain dict keyed by the
+    original metric names (``read_cursor`` returns pytree-path keys like
+    ``['loss_sum']``). None when the cursor carries no accumulator."""
+    acc = (cursor or {}).get("acc") or None
+    if not acc:
+        return None
+    out: Dict[str, np.ndarray] = {}
+    for k, v in acc.items():
+        m = _KEYSTR_RE.match(k)
+        out[m.group(1) if m else k] = v
+    return out
+
+
+class EpochTailLoader:
+    """A view of ``loader`` starting at batch ``start`` — the resumed
+    epoch's remaining batches, fetched by RANDOM ACCESS through
+    ``make_batch_plan`` so the applied prefix is never assembled (zero
+    batches replayed). Falls back to iterate-and-discard only for loaders
+    without a plan. Everything else forwards to the underlying loader."""
+
+    def __init__(self, loader, start: int):
+        self.loader = loader
+        self.start = int(start)
+
+    def __len__(self) -> int:
+        return max(0, len(self.loader) - self.start)
+
+    def __iter__(self):
+        plan = getattr(self.loader, "make_batch_plan", None)
+        if plan is not None:
+            steps, fetch = plan()
+            for s in range(self.start, steps):
+                yield fetch(s)
+            return
+        it = iter(self.loader)
+        for _ in range(self.start):
+            try:
+                next(it)
+            except StopIteration:
+                return
+        yield from it
+
+    def __getattr__(self, name):
+        return getattr(self.loader, name)
+
+
+# ---------------------------------------------------------------- engine --
+
+
+class _Job:
+    __slots__ = ("state", "acc", "topology", "epoch", "step", "plan_key")
+
+    def __init__(self, state, acc, topology, epoch, step, plan_key):
+        self.state = state
+        self.acc = acc
+        self.topology = topology
+        self.epoch = int(epoch)
+        self.step = int(step)
+        self.plan_key = plan_key
+
+
+_STOP = object()
+
+
+def writer_stats_path(path: str) -> str:
+    """The writer-statistics sidecar of a snapshot. A separate file, NOT an
+    entry in the npz: the payload must stay byte-identical between async
+    and sync writers, and 'how busy was the writer' is exactly the kind of
+    mode-dependent fact that would break that."""
+    return path + ".writer.json"
+
+
+def read_writer_stats(path: str) -> Optional[dict]:
+    """The ``.writer.json`` sidecar of snapshot ``path`` (None if absent)."""
+    try:
+        with open(writer_stats_path(path), "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class SnapshotEngine:
+    """The async step-granular checkpoint engine (module doc). One per
+    training run; construct with the resolved config, call
+    :meth:`begin_epoch` per epoch, :meth:`maybe` from the dispatch loop,
+    :meth:`flush`/:meth:`final_snapshot` from the preempt drain, and
+    :meth:`close` on the way out."""
+
+    def __init__(
+        self,
+        save_dir: str,
+        cfg: SnapshotConfig,
+        *,
+        prefix: str = "ckpt",
+        world_size: Optional[int] = None,
+        keep_last: Optional[int] = None,
+        tracer=None,
+        flight=None,
+    ):
+        self.save_dir = save_dir
+        self.cfg = cfg
+        self.prefix = prefix
+        self.world_size = world_size
+        self.keep_last = keep_last
+        self.tracer = tracer if tracer is not None else trace_lib.NULL_TRACER
+        self.flight = flight
+        self.trace_parent = None  # the current epoch span (loop sets it)
+        self.stats: Dict[str, Any] = {
+            "snapshots": 0,
+            "skipped_queue_full": 0,
+            "flushes": 0,
+            "write_s": 0.0,
+            "bytes": 0,
+            "last_epoch": None,
+            "last_step": None,
+            "last_path": None,
+        }
+        self._disarmed: Optional[str] = None
+        self._next_due = cfg.every_steps
+        self._lock = threading.Lock()
+        self._errors: List[str] = []
+        self._queue: Optional[queue.Queue] = (
+            queue.Queue(maxsize=cfg.inflight) if cfg.async_writes else None
+        )
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        # process 0 owns the write (the single-writer checkpoint
+        # discipline); other processes keep a live engine but never enqueue
+        self._is_writer = jax.process_index() == 0
+
+    # ------------------------------------------------------------ public --
+
+    def describe(self) -> Dict[str, Any]:
+        """The run_meta (schema v11) snapshot-provenance block."""
+        out = self.cfg.as_dict()
+        out["prefix"] = self.prefix
+        if self._disarmed:
+            out["disarmed"] = self._disarmed
+        return out
+
+    def begin_epoch(self, epoch: int, start_step: int = 0) -> None:
+        """Reset the cadence for ``epoch`` (a resumed epoch passes the
+        cursor step so the next snapshot lands one full cadence later)."""
+        self._next_due = int(start_step) + self.cfg.every_steps
+
+    def maybe(self, state, *, epoch: int, step: int, plan_key, drain=None) -> bool:
+        """Snapshot ``state`` at ``(epoch, step)`` if the cadence is due.
+        NEVER blocks the step loop: a full writer queue skips (counted in
+        ``skipped_queue_full``) rather than waits. Returns True when a
+        snapshot was taken (async: enqueued)."""
+        if (
+            self._disarmed
+            or not self.cfg.enabled
+            or not self._is_writer
+            or step < self._next_due
+        ):
+            return False
+        if self._queue is not None and self._queue.full():
+            self.stats["skipped_queue_full"] += 1
+            return False
+        if not self._addressable(state):
+            return False
+        span = self.tracer.start_span(
+            "snapshot", trace_lib.KIND_ACTION, parent=self.trace_parent,
+            attrs={"epoch": int(epoch), "step": int(step),
+                   "mode": "async" if self.cfg.async_writes else "sync"},
+        )
+        # partial accumulator: fold the pipeline's pending readbacks
+        # device-side (no host sync) so the accumulator matches the state
+        acc = drain.drain() if drain is not None else None
+        # on-device copy — an async dispatch; the copy survives the
+        # donation of the original buffers by the next step
+        copied_state = jax.tree_util.tree_map(jnp.copy, state)
+        copied_acc = (
+            jax.tree_util.tree_map(jnp.copy, acc) if acc is not None else None
+        )
+        topology = ckpt.derive_topology(state, self.world_size)
+        job = _Job(copied_state, copied_acc, topology, epoch, step, plan_key)
+        if self._queue is not None:
+            self._ensure_thread()
+            try:
+                self._queue.put_nowait(job)
+            except queue.Full:
+                self.stats["skipped_queue_full"] += 1
+                self.tracer.end_span(span, skipped="queue_full")
+                return False
+            self.tracer.end_span(span, enqueued=True)
+        else:
+            self._write(job)
+            self.tracer.end_span(span)
+        self._next_due = int(step) + self.cfg.every_steps
+        return True
+
+    def flush(self) -> Optional[int]:
+        """Block until every in-flight snapshot is on disk; returns the
+        step of the last PUBLISHED snapshot (None if none yet). The preempt
+        drain calls this first — the in-flight snapshot it waits for is
+        work already done, so exit latency is the final delta only."""
+        if self._queue is not None and self._thread is not None:
+            self._queue.join()
+        self.stats["flushes"] += 1
+        return self.stats["last_step"]
+
+    def final_snapshot(
+        self, state, *, epoch: int, step: int, plan_key, acc=None
+    ) -> Optional[str]:
+        """The preempt drain's final delta: flush in-flight work, then write
+        ``state`` at ``(epoch, step)`` INLINE (the exit path must not race
+        its own writer thread). Returns the published path (None off-writer
+        or disarmed)."""
+        self.flush()
+        if not self._is_writer or self._disarmed or not self._addressable(state):
+            return None
+        if self.stats["last_epoch"] == int(epoch) and self.stats["last_step"] == int(step):
+            return self.stats["last_path"]  # flush already published it
+        job = _Job(state, acc, ckpt.derive_topology(state, self.world_size),
+                   epoch, step, plan_key)
+        return self._write(job)
+
+    def close(self) -> None:
+        """Flush and stop the writer thread. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._queue is not None and self._thread is not None:
+            self._queue.join()
+            self._queue.put(_STOP)
+            self._thread.join(timeout=60)
+        if self._errors:
+            logger.warning(
+                "snapshot writer finished with %d error(s); first: %s",
+                len(self._errors), self._errors[0],
+            )
+
+    # ----------------------------------------------------------- private --
+
+    def _addressable(self, state) -> bool:
+        """Disarm (once, with a warning) when the state holds leaves this
+        process cannot serialize without a collective — the cross-host
+        weight-update-sharded case. A background thread must never join a
+        collective, so those runs keep the epoch-granular save path."""
+        if self._disarmed:
+            return False
+        for leaf in jax.tree_util.tree_leaves(state):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                self._disarmed = (
+                    "state holds cross-host-sharded leaves (weight-update "
+                    "sharding across processes); step snapshots need a "
+                    "collective gather the background writer cannot join — "
+                    "falling back to epoch-granular checkpoints"
+                )
+                logger.warning("snapshot engine disarmed: %s", self._disarmed)
+                return False
+        return True
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._worker, name="tpuddp-snapshot-writer", daemon=True
+            )
+            self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            job = self._queue.get()
+            try:
+                if job is _STOP:
+                    return
+                self._write(job)
+            except Exception as e:  # noqa: BLE001 — a failed snapshot must
+                # never take down training; the next cadence retries
+                logger.exception("snapshot write failed: %s", e)
+                self._errors.append(str(e))
+            finally:
+                self._queue.task_done()
+
+    def _peer_dir(self) -> Optional[str]:
+        from tpuddp.resilience import watchdog
+
+        hb = watchdog.heartbeat_dir(self.save_dir)
+        if not hb:
+            return None
+        ring = (jax.process_index() + 1) % max(jax.process_count(), 1)
+        return os.path.join(hb, "peer_ckpt", f"ring_{ring}")
+
+    def _spill_peer(self, path: str) -> None:
+        """Copy the published snapshot (payload + manifest) into the ring
+        neighbor's spill directory — atomic per file, best-effort by the
+        no-stall contract (a failed spill is logged, never raised)."""
+        peer = self._peer_dir()
+        if peer is None:
+            return
+        try:
+            os.makedirs(peer, exist_ok=True)
+            for src in (path, integrity.manifest_path(path)):
+                if not os.path.exists(src):
+                    continue
+                dst = os.path.join(peer, os.path.basename(src))
+                tmp = dst + ".tmp"
+                shutil.copyfile(src, tmp)
+                os.replace(tmp, dst)
+            if self.keep_last is not None:
+                ckpt.prune_checkpoints(peer, self.keep_last, self.prefix)
+        except OSError as e:
+            logger.warning("peer-redundant spill to %s failed: %s", peer, e)
+
+    def _write(self, job: _Job) -> str:
+        """Serialize one snapshot — the background writer's body, also run
+        inline for sync mode and the final delta. Same ``checkpoint.save``
+        path as a synchronous save: byte-identical output."""
+        t0 = time.perf_counter()
+        target = ckpt.step_checkpoint_path(
+            self.save_dir, job.epoch, job.step, self.prefix
+        )
+        os.makedirs(self.save_dir, exist_ok=True)
+        cursor = {
+            "version": ckpt.FORMAT_VERSION,
+            "epoch": job.epoch,
+            "step": job.step,
+            "plan_key": job.plan_key,
+        }
+        path = ckpt.save(
+            target,
+            job.state,
+            meta={"epoch": job.epoch, "completed": 0, "step": job.step},
+            topology=job.topology,
+            cursor=cursor,
+            cursor_acc=job.acc,
+        )
+        # chaos hook: corrupt@ckpt_E_sS garbles the published snapshot —
+        # restore must then fall back to the next-freshest (or a peer copy)
+        faults.maybe_fire(
+            "ckpt", name=f"{self.prefix}_{job.epoch}_s{job.step}", path=path
+        )
+        if self.cfg.peer_redundancy:
+            self._spill_peer(path)
+        if self.keep_last is not None:
+            ckpt.prune_checkpoints(self.save_dir, self.keep_last, self.prefix)
+        with self._lock:
+            self.stats["snapshots"] += 1
+            self.stats["write_s"] += time.perf_counter() - t0
+            try:
+                self.stats["bytes"] += os.path.getsize(path)
+            except OSError:
+                pass
+            self.stats["last_epoch"] = job.epoch
+            self.stats["last_step"] = job.step
+            self.stats["last_path"] = path
+            sidecar = {
+                "async": self.cfg.async_writes,
+                "inflight": self.cfg.inflight,
+                "peer_redundancy": self.cfg.peer_redundancy,
+                "snapshots": self.stats["snapshots"],
+                "skipped_queue_full": self.stats["skipped_queue_full"],
+                "write_s": round(self.stats["write_s"], 6),
+                "bytes": self.stats["bytes"],
+            }
+        try:
+            tmp = writer_stats_path(path) + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(sidecar, f, sort_keys=True)
+            os.replace(tmp, writer_stats_path(path))
+        except OSError:
+            pass
+        if self.flight is not None:
+            self.flight.note(
+                snapshot_last={
+                    "epoch": job.epoch, "step": job.step,
+                    "path": os.path.basename(path),
+                }
+            )
+        return path

@@ -25,6 +25,7 @@ import numpy as np
 from tpuddp import config as cfg_lib
 from tpuddp import nn
 from tpuddp.accelerate import Accelerator
+from tpuddp.resilience import faults
 from tpuddp.resilience.guard import ReplicaDesync
 from tpuddp.resilience.preemption import (
     EXIT_DESYNC,
@@ -59,13 +60,26 @@ def setup_dataloaders(training):
 
 def train(
     model, train_loader, criterion, optimizer, accelerator, augment,
-    tel=None,
+    tel=None, start_batch=0, carried=None, poll=None, progress=None,
+    total_batches=None,
 ):
     """One training epoch. Returns ``(mean_batch_loss, samples_seen)`` —
     the weighted sample count feeds the history.jsonl throughput fields.
     ``tel`` (observability.RunTelemetry) brackets each optimizer step with
     its host-side timing/profiling hooks; under fuse_steps the laps measure
     dispatch rate (the queue flushes every K steps), never forcing a flush.
+
+    Step-granular resume/drain (training/snapshot.py, the v4 cursor): the
+    caller hands a tail loader plus ``start_batch`` (the epoch offset its
+    batches start at), ``carried`` ({loss_total, n_seen} from the cursor's
+    partial accumulator — seeds this pass's sums so the epoch row equals the
+    uninterrupted run's), and ``total_batches`` (the FULL epoch's batch
+    count, the mean-loss denominator). ``poll`` is checked once per
+    completed gradient-accumulation cycle (never mid-cycle — save_state
+    refuses a partial cycle); when it returns True the pass stops and
+    ``progress`` (a caller-owned dict) records ``interrupted=True`` plus the
+    epoch step / loss total / samples so the drain can write an
+    exactly-resumable step snapshot.
 
     Deferred readback (the async pipeline, tpuddp/training/pipeline.py): the
     per-batch ``loss.item()`` host sync the reference pays (quirk Q5) is
@@ -76,8 +90,13 @@ def train(
     step (``Accelerator(augment=...)``) and raw decoded batches feed
     ``model(...)`` directly — host workers only decode and stack."""
     model.train()
-    n_seen = 0.0
+    n_seen = float(carried["n_seen"]) if carried else 0.0
+    carried_loss = float(carried["loss_total"]) if carried else None
+    interrupted_at = None
     batch_losses = []
+    # step-site chaos hook (resilience/faults.py): armed only while an
+    # un-fired step fault exists, so normal runs pay nothing per batch
+    fault_step = {"i": start_batch} if faults.has_step_fault() else None
     # fuse_steps bookkeeping for the step recorder: an optimizer.step() that
     # merely queues (fuse_steps=K enqueues K-1 of every K) is host-side
     # microseconds, and crediting it as a step would report bookkeeping time
@@ -110,6 +129,13 @@ def train(
     for i, (inputs, labels, weights) in enumerate(
         stalled_iter(train_loader, stall)
     ):
+        if fault_step is not None:
+            # preempt@step=N / crash@step=N kill the managed run MID-epoch
+            # (the step index is the epoch-global batch count, tail-resume
+            # aware); the drain poll below runs AFTER the fault so the
+            # signal it raised is seen at this same accum-cycle boundary
+            faults.maybe_fire("step", step=fault_step["i"])
+            fault_step["i"] += 1
         # no .to(device): placement is the backend's job (reference :44 note)
         batch_n = float(np.sum(weights))
         n_seen += batch_n
@@ -118,8 +144,10 @@ def train(
         if augment is not None:
             # Flip-augmented inputs (reference transform_train includes
             # RandomHorizontalFlip, data_and_toy_model.py:14-19), keyed off
-            # the accelerator's per-process PRNG stream.
-            x = augment(aug_base, i, jnp.asarray(inputs))
+            # the accelerator's per-process PRNG stream. The fold index is
+            # the epoch-global batch position (start_batch + i) so a
+            # tail-resumed pass keys each batch exactly as the original did.
+            x = augment(aug_base, start_batch + i, jnp.asarray(inputs))
         else:
             x = inputs  # normalize/flip/resize run inside the step program
 
@@ -148,10 +176,21 @@ def train(
         # collect the LazyLoss; its value materializes when the fuse queue
         # flushes (or at the epoch-end drain) — never a per-batch host sync
         batch_losses.append(loss)
+        if (
+            poll is not None
+            and not getattr(optimizer, "_accum_count", 0)
+            and poll()
+        ):
+            # drain request seen at an accum-cycle boundary: stop HERE —
+            # every applied update is a committed step, the cursor names
+            # the epoch step the resume continues at
+            interrupted_at = start_batch + i + 1
+            break
     # a partial gradient-accumulation cycle applies at dataloader end (the
-    # HF accumulate() contract) instead of leaking into the next epoch
+    # HF accumulate() contract) instead of leaking into the next epoch; an
+    # interrupted pass stopped AT a cycle boundary, so this is a no-op there
     flush_accum = getattr(optimizer, "flush_accumulation", None)
-    if flush_accum is not None:
+    if flush_accum is not None and interrupted_at is None:
         flush_accum()
     # the deferred readback drain: sum on device (array-at-a-time over fused
     # flushes), ONE host fetch — per-batch scalar reads cost a dispatch AND a
@@ -159,11 +198,20 @@ def train(
     # dispatch-latency-bound runtimes (BASELINE.md's 1,532 samples/s row)
     from tpuddp.accelerate import sum_losses
 
-    running_loss = float(sum_losses(batch_losses))
+    running_loss = float(sum_losses(batch_losses, initial=carried_loss))
     # a ragged tail left in the fuse queue was flushed by sum_losses (or by
     # flush_accumulation above): attribute its steps now, post-fence
     post_if_flushed(force=True)
-    return running_loss / len(train_loader), n_seen
+    if progress is not None:
+        progress["interrupted"] = interrupted_at is not None
+        progress["step"] = (
+            interrupted_at if interrupted_at is not None
+            else start_batch + len(train_loader)
+        )
+        progress["loss_total"] = running_loss
+        progress["n_seen"] = n_seen
+    denom = total_batches if total_batches is not None else len(train_loader)
+    return running_loss / denom, n_seen
 
 
 def transform_host(transform, inputs):
@@ -229,6 +277,7 @@ def run_training_loop(
     run_meta=None,
     pipeline=None,
     observability=None,
+    snapshot=None,
 ):
     # Observability parity with the native epoch driver (training/loop.py):
     # the typed run_meta header opens history.jsonl, epoch rows carry the
@@ -254,8 +303,17 @@ def run_training_loop(
     from tpuddp.resilience import watchdog as wd_lib
 
     from tpuddp.training.pipeline import resolve_pipeline
+    from tpuddp.training import snapshot as snapshot_lib
 
     obs_cfg = cfg_lib.resolve_observability(observability)
+    # training.snapshot (managed flavor): step-granular preemption drains +
+    # exact mid-epoch resume. The managed path has no background writer (the
+    # fuse queue is its own overlap story) — armed, a drain caught at an
+    # accum-cycle boundary writes state_{epoch}_s{step}.npz with the v4 data
+    # cursor, and load_state's cursor routes the NEXT run back here to
+    # continue that epoch at that step with zero batches replayed.
+    snap_cfg = snapshot_lib.resolve_snapshot(snapshot)
+    pending_cursor = {"c": getattr(accelerator, "last_restore_cursor", None)}
     flight = None
     if obs_cfg["flight_recorder"] and save_dir is not None:
         flight = flight_lib.install(flight_lib.FlightRecorder(
@@ -322,6 +380,12 @@ def run_training_loop(
         guard=guard_cfg,
         observability=obs_meta,
         comm={"overlap": dict(_overlap)} if _overlap is not None else None,
+        # v11 snapshot provenance: the managed flavor (drain-time step
+        # snapshots, no background writer); False = epoch-granular only
+        snapshot=(
+            {**snap_cfg.as_dict(), "mode": "drain"}
+            if snap_cfg.enabled else False
+        ),
         extra=meta_extra,
     ))
     for ev in restore_events:
@@ -426,6 +490,55 @@ def run_training_loop(
             flight.dump("preempt")
         raise TrainingPreempted(last_completed_epoch + 1)
 
+    def mid_drain(epoch, prog):
+        """Step-granular preemption drain (training.snapshot armed): the
+        train pass stopped at an accum-cycle boundary mid-epoch — publish
+        ``state_{epoch}_s{step}.npz`` with the v4 data cursor (plan key +
+        partial loss/sample totals) so the requeued run continues THIS epoch
+        at THIS step with zero batches replayed, retiring the managed
+        path's redo-the-epoch resume."""
+        step = int(prog["step"])
+        accelerator.wait_for_everyone()
+        accelerator.save_state(
+            model, optimizer, save_dir, epoch=epoch, step=step,
+            cursor={
+                "plan_key": snapshot_lib.epoch_plan_key(train_loader, epoch),
+                "acc": {
+                    "loss_total": np.asarray(prog["loss_total"], np.float64),
+                    "n_seen": np.asarray(prog["n_seen"], np.float64),
+                },
+            },
+        )
+        if accelerator.is_local_main_process:
+            print(
+                f"Preempted: step snapshot for epoch {epoch} step {step} "
+                f"saved (exact resume)."
+            )
+        metrics_writer.write(stamp("event", {
+            "event": "preempt",
+            "epoch": epoch,
+            "completed": False,
+            "step": tel.recorder.global_step,
+            "snapshot_step": step,
+        }))
+        metrics_writer.sync()
+        if flight is not None:
+            flight.note(
+                emergency_epoch=epoch,
+                emergency_step=tel.recorder.global_step,
+                snapshot_final_step=step,
+            )
+            flight.dump("preempt")
+        raise TrainingPreempted(epoch)
+
+    # per-batch drain polling is single-host-only (one host stopping
+    # mid-pass while peers still issue step collectives would wedge the
+    # pod) and opt-in via the snapshot block
+    poll_cb = (
+        preemption_requested
+        if snap_cfg.enabled and jax.process_count() == 1 else None
+    )
+
     try:
         epoch = start_epoch
         while epoch < num_epochs:
@@ -464,21 +577,68 @@ def run_training_loop(
                         bad_leaf, where=f"epoch {epoch} audit"
                     )
             train_loader.set_epoch(epoch)
+            # exact mid-epoch resume: a v4 cursor stashed by load_state for
+            # THIS epoch skips the already-applied batch-plan prefix and
+            # seeds the loss/sample totals it carried — the epoch row comes
+            # out equal to the uninterrupted run's. A plan-key mismatch
+            # (different sampler config, resharded restore) falls back to
+            # the legacy redo-the-epoch path.
+            start_batch, carried, pass_loader = 0, None, train_loader
+            cur = pending_cursor["c"]
+            if cur is not None and int(cur.get("epoch", -1)) == epoch:
+                pending_cursor["c"] = None
+                expect = snapshot_lib.epoch_plan_key(train_loader, epoch)
+                if cur.get("plan_key") == expect:
+                    start_batch = int(cur["step"])
+                    acc = snapshot_lib.acc_from_cursor(cur)
+                    carried = {
+                        "loss_total": float(
+                            np.asarray(acc.get("loss_total", 0.0))
+                        ),
+                        "n_seen": float(np.asarray(acc.get("n_seen", 0.0))),
+                    }
+                    pass_loader = snapshot_lib.EpochTailLoader(
+                        train_loader, start_batch
+                    )
+                    if accelerator.is_local_main_process:
+                        print(
+                            f"Exact resume: epoch {epoch} continues at step "
+                            f"{start_batch} (zero batches replayed)."
+                        )
+                else:
+                    logging.getLogger("tpuddp").warning(
+                        "step snapshot plan key mismatch for epoch %d: data "
+                        "order changed, redoing the epoch from the restored "
+                        "state", epoch,
+                    )
+            elif cur is not None:
+                pending_cursor["c"] = None
             epoch_t0 = time.perf_counter()
             tel.start_epoch(epoch)
+            progress = {}
             train_loss, train_samples = train(
                 model,
-                train_loader,
+                pass_loader,
                 criterion,
                 optimizer,
                 accelerator,
                 augment,
                 tel=tel,
+                start_batch=start_batch,
+                carried=carried,
+                poll=poll_cb,
+                progress=progress,
+                total_batches=len(train_loader),
             )
             # the train pass is done (its end-of-epoch drain materialized
             # the losses — the fence); summarize before eval time can leak
             # in, but keep any SIGUSR1 epoch trace running through evaluation
             step_fields = tel.end_epoch(stop_trace=False)
+            if progress.get("interrupted"):
+                # the pass stopped at an accum-cycle boundary mid-epoch:
+                # write the exactly-resumable step snapshot (never the
+                # "epoch done" drain below — its updates are NOT all applied)
+                mid_drain(epoch, progress)
             if preemption_requested():
                 # the train pass completed, so every update of this epoch is
                 # applied — save it as done and lose only the eval metrics
@@ -746,7 +906,13 @@ def basic_accelerate_training(
         x0 = eval_transform(jnp.asarray(np.asarray(img0)[None]))
         model(x0)
         start_epoch = accelerator.load_state(model, optimizer, out_dir)
-        if start_epoch and accelerator.is_local_main_process:
+        cursor = getattr(accelerator, "last_restore_cursor", None)
+        if cursor is not None and accelerator.is_local_main_process:
+            print(
+                f"Resumed from step snapshot: epoch {start_epoch} step "
+                f"{int(cursor.get('step', 0))}."
+            )
+        elif start_epoch and accelerator.is_local_main_process:
             print(f"Resumed from epoch {start_epoch - 1} state.")
 
     from tpuddp.observability import config_hash
@@ -768,6 +934,8 @@ def basic_accelerate_training(
         step_stats_every=int(training.get("step_stats_every") or 0),
         pipeline=pipeline_cfg,
         observability=observability,
+        # step-granular preemption drains + exact mid-epoch resume
+        snapshot=training.get("snapshot"),
         # run provenance for the history header: which configuration was this?
         run_meta={
             "config_hash": config_hash(training),

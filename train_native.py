@@ -207,6 +207,9 @@ def basic_ddp_training_loop(
         # live telemetry plane (observability block): opt-in /metrics
         # exporter, pod aggregation + straggler detection, flight recorder
         observability=observability,
+        # async step-granular checkpointing (training/snapshot.py): step
+        # snapshots with v4 data cursors for exact mid-epoch resume
+        snapshot=training.get("snapshot"),
         run_meta={
             "config_hash": obs.config_hash(training),
             "model": training.get("model"),
