@@ -234,6 +234,120 @@ def check_regressions(artifacts, candidate, threshold: float):
     return regressions
 
 
+# ------------------------------------------------------------ TUNE family --
+# TUNE_r*.json (tools/autotune.py): per-rule predicted-vs-measured deltas,
+# a different shape from the rate families — tracked per (rule, metric,
+# device), never mixed into the rate trend.
+
+
+def load_tune_artifacts(repo=_REPO):
+    """Committed TUNE_r*.json artifacts in release order:
+    ``(tag, device, results)`` with only well-formed result rows kept."""
+    artifacts = []
+    for path in sorted(glob.glob(os.path.join(repo, "TUNE_r*.json"))):
+        tag = os.path.splitext(os.path.basename(path))[0]
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench_trend: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+            continue
+        if not isinstance(obj, dict) or obj.get("type") != "tune_report":
+            continue
+        rows = [
+            r for r in obj.get("results") or []
+            if isinstance(r, dict) and isinstance(r.get("rule"), str)
+        ]
+        if rows:
+            artifacts.append((tag, obj.get("device") or "unknown", rows))
+    return artifacts
+
+
+def print_tune_trend(tune_artifacts) -> None:
+    """Per-rule predicted -> measured trajectory across TUNE releases.
+    A cell reads ``+50.0->+48.2`` (endorsed) or ``+14.0->-3.1 !`` (probe
+    REFUSED endorsement)."""
+    seen = []
+    for _tag, device, rows in tune_artifacts:
+        for r in rows:
+            key = (device, r["rule"], r.get("metric"))
+            if key not in seen:
+                seen.append(key)
+    header = (["rule", "metric", "device"]
+              + [tag for tag, _, _ in tune_artifacts])
+    out = []
+    for device, rule, metric in seen:
+        cells = [rule[:32], str(metric)[:24], device]
+        for _tag, dev, rows in tune_artifacts:
+            row = next(
+                (r for r in rows
+                 if dev == device and r["rule"] == rule
+                 and r.get("metric") == metric),
+                None,
+            )
+            if row is None:
+                cells.append("-")
+                continue
+            pred = row.get("predicted_delta_pct")
+            meas = row.get("measured_delta_pct")
+            pred_s = f"{pred:+.1f}" if isinstance(pred, (int, float)) else "?"
+            meas_s = f"{meas:+.1f}" if isinstance(meas, (int, float)) else "?"
+            cells.append(
+                f"{pred_s}->{meas_s}" + ("" if row.get("endorsed") else " !")
+            )
+        out.append(cells)
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in out)) if out
+        else len(header[i])
+        for i in range(len(header))
+    ]
+    print("\ntune trajectory (predicted->measured improvement %, "
+          "'!' = endorsement refused):")
+    print("  ".join(h.ljust(w) for h, w in zip(header, widths)))
+    print("-" * (sum(widths) + 2 * (len(widths) - 1)))
+    for r in out:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+
+
+def check_tune_regressions(tune_artifacts):
+    """A rule the probe ENDORSED in an earlier release but now refuses —
+    same (rule, metric, device), judged per metric so a rule probed on a
+    new metric never regresses against an old one. Returns description
+    strings (empty = pass)."""
+    if len(tune_artifacts) < 2:
+        return []
+    cand_tag, cand_device, cand_rows = tune_artifacts[-1]
+    history = tune_artifacts[:-1]
+    regressions = []
+    for row in cand_rows:
+        if row.get("endorsed"):
+            continue
+        for tag, device, rows in history:
+            if device != cand_device:
+                continue
+            prev = next(
+                (r for r in rows
+                 if r["rule"] == row["rule"]
+                 and r.get("metric") == row.get("metric")
+                 and r.get("endorsed")),
+                None,
+            )
+            if prev is not None:
+                meas = row.get("measured_delta_pct")
+                meas_s = (
+                    f"{meas:+.1f}%" if isinstance(meas, (int, float))
+                    else "unmeasured"
+                )
+                regressions.append(
+                    f"tune rule {row['rule']!r} on {row.get('metric')} "
+                    f"({cand_device}): endorsed in {tag}, now {meas_s} in "
+                    f"{cand_tag} — the probe refused endorsement"
+                )
+                break
+    return regressions
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Per-row bench trajectory across committed BENCH_r*.json "
@@ -250,6 +364,13 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     artifacts, candidates = load_artifacts(args.fresh, repo=args.repo)
+    tune_artifacts = load_tune_artifacts(repo=args.repo)
+    if tune_artifacts:
+        print_tune_trend(tune_artifacts)
+        print()
+    else:
+        print("bench_trend: no TUNE_r*.json artifacts with result rows — "
+              "no tune trajectory to report (not a failure)")
     if not artifacts:
         # a fresh clone (no committed BENCH_r*/SERVING_r* artifacts yet) has
         # no trajectory to regress against — an empty gate, not a failure
@@ -268,6 +389,10 @@ def main(argv=None) -> int:
     regressions = []
     for candidate in candidates:
         regressions += check_regressions(artifacts, candidate, args.threshold)
+    if not args.fresh:
+        # tune regressions only judge committed artifacts against each
+        # other — a --fresh bench candidate says nothing about tuning
+        regressions += check_tune_regressions(tune_artifacts)
     if regressions:
         for r in regressions:
             print(f"REGRESSION: {r}", file=sys.stderr)

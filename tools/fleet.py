@@ -16,6 +16,10 @@ Subcommands:
           occupancy_high: 0.9
           hysteresis: 2
           cooldown_s: 30.0
+        tune:                         # optional online tuner (tune/online.py)
+          report: TUNE_r01.json       # offline probe's endorsement list
+          cooldown_s: 300.0           # ... any TunePolicy knob
+          # trust_advisor: true       # act on unprobed predictions (opt-in)
         jobs:
           - name: cnn-a
             kind: training            # training | serving
@@ -75,6 +79,11 @@ def cmd_run(args) -> int:
     from tpuddp.fleet.autoscale import Autoscaler, AutoscalePolicy
     from tpuddp.fleet.controller import FleetController
     from tpuddp.fleet.spec import spec_from_dict
+    from tpuddp.tune.online import (
+        FleetTuner,
+        TunePolicy,
+        endorsed_rules_from_report,
+    )
 
     spec = _load_yaml(args.spec)
     pool = int(spec.get("pool") or 0)
@@ -84,8 +93,32 @@ def cmd_run(args) -> int:
     autoscaler = None
     if spec.get("autoscale"):
         autoscaler = Autoscaler(AutoscalePolicy(**spec["autoscale"]))
+    # optional online tuner (tpuddp/tune/online.py):
+    #   tune:
+    #     report: TUNE_r01.json      # the offline probe's endorsement list
+    #     cooldown_s: 300.0          # ... any TunePolicy knob
+    # without 'report' the tuner stays inert (nothing is endorsed) unless
+    # 'trust_advisor: true' explicitly opts into unprobed predictions.
+    tuner = None
+    if spec.get("tune"):
+        tune_cfg = dict(spec["tune"])
+        report = tune_cfg.pop("report", None)
+        trust = bool(tune_cfg.pop("trust_advisor", False))
+        if trust:
+            endorsed = None
+        elif report:
+            endorsed = endorsed_rules_from_report(
+                report if os.path.isabs(report)
+                else os.path.join(_REPO, report)
+            )
+        else:
+            endorsed = set()
+        tuner = FleetTuner(
+            policy=TunePolicy(**tune_cfg), endorsed_rules=endorsed,
+        )
     controller = FleetController(
-        pool, fleet_dir=fleet_dir, autoscaler=autoscaler,
+        pool, fleet_dir=fleet_dir, autoscaler=autoscaler, tuner=tuner,
+        observability=spec.get("observability"),
     )
     for entry in spec.get("jobs") or []:
         controller.submit(spec_from_dict(entry))

@@ -157,6 +157,15 @@ supervisor summarizes — --flight-dir — before resuming the run to
 completion). A dead endpoint, schema-v5 drift, a missing crash recording,
 or a bench regression all fail here.
 
+Autotune gate (last): the self-tuning loop (ISSUE 19). A deliberately
+mis-knobbed traced dryrun (synchronous pipeline, per-step snapshots, no
+comm compression) must make ``tpuddp_inspect tune`` fire recommendations
+across >= 3 distinct rule classes with evidence citations; ``tools/
+autotune.py --quick`` must A/B the diffs through the real epoch driver and
+land a schema-v12-valid TUNE report (endorsement honesty validated, not
+trusted); and the fleet tuner's apply/measure/revert unit matrix — with an
+injected regression forcing the auto-revert — must pass.
+
 Usage: python tools/run_full_gate.py [extra pytest args]
 
 The two-tier contract is documented in README "Testing"; the chaos tier can
@@ -1594,6 +1603,121 @@ def _tracing_gate(env) -> int:
     return 0
 
 
+def _autotune_gate(env) -> int:
+    """Self-tuning leg (ISSUE 19): (a) a deliberately mis-knobbed traced
+    dryrun (synchronous pipeline, per-step snapshots, no comm compression)
+    must make ``tpuddp_inspect tune`` fire recommendations across >= 3
+    distinct rule classes, each citing its evidence; (b) ``tools/autotune.py
+    --quick`` must A/B the advisor's diffs through the real epoch driver and
+    write a TUNE report that ``tpuddp_inspect --validate`` accepts under
+    schema v12 (the endorsement-honesty contract is validated, not trusted);
+    (c) the fleet tuner's apply/measure/revert state machine must pass its
+    unit matrix — including the injected-regression auto-revert — via
+    ``pytest tests/test_tune.py -k fleet``."""
+    import json
+
+    inspect = os.path.join(REPO, "tools", "tpuddp_inspect.py")
+    worker = os.path.join(REPO, "tests", "_chaos_train_worker.py")
+    with tempfile.TemporaryDirectory(prefix="tpuddp_tune_gate_") as tmp:
+        base_env = dict(env)
+        base_env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "TPUDDP_BACKEND": "cpu",
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        # -- leg a: the bad-knob dryrun the advisor must see through
+        run_dir = os.path.join(tmp, "badknobs")
+        os.makedirs(run_dir)
+        worker_env = dict(base_env)
+        worker_env.update({
+            "TPUDDP_CHAOS_TRAINING": json.dumps({
+                "pipeline": False,
+                "snapshot": {"every_steps": 1, "inflight": 1},
+                "step_stats_every": 4,
+            }),
+            "TPUDDP_CHAOS_OBS": '{"tracing": true}',
+        })
+        rc = subprocess.call(
+            [sys.executable, "-u", worker, run_dir, "2"],
+            cwd=REPO, env=worker_env,
+        )
+        if rc != 0:
+            print(f"autotune gate: bad-knob dryrun exited {rc}",
+                  file=sys.stderr)
+            return rc or 1
+        out = subprocess.run(
+            [sys.executable, inspect, "tune", run_dir, "--json"],
+            cwd=REPO, env=env, stdout=subprocess.PIPE, text=True,
+        )
+        if out.returncode != 0:
+            print(f"autotune gate: tpuddp_inspect tune exited "
+                  f"{out.returncode}", file=sys.stderr)
+            return out.returncode
+        report = json.loads(out.stdout)
+        recs = report.get("recommendations") or []
+        classes = sorted({r.get("rule_class") for r in recs})
+        if len(classes) < 3:
+            print(
+                "autotune gate: the advisor fired "
+                f"{[r.get('rule') for r in recs]} — expected >= 3 distinct "
+                f"rule classes on the bad-knob run, got {classes}",
+                file=sys.stderr,
+            )
+            return 1
+        if any(not r.get("evidence") for r in recs):
+            print("autotune gate: a recommendation shipped without evidence "
+                  "citations", file=sys.stderr)
+            return 1
+        # -- leg b: the A/B probe must measure the diffs and write a report
+        # its own reader accepts (validated again here, independently)
+        tune_json = os.path.join(tmp, "TUNE_gate.json")
+        rc = subprocess.call(
+            [
+                sys.executable, "-u",
+                os.path.join(REPO, "tools", "autotune.py"),
+                "--quick", "--out", tune_json,
+            ],
+            cwd=REPO, env=base_env,
+        )
+        if rc != 0:
+            print(f"autotune gate: autotune --quick exited {rc}",
+                  file=sys.stderr)
+            return rc
+        if not os.path.exists(tune_json):
+            print("autotune gate: autotune --quick wrote no report",
+                  file=sys.stderr)
+            return 1
+        rc = subprocess.call(
+            [sys.executable, inspect, "--validate", tune_json],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("autotune gate: the TUNE report failed schema-v12 "
+                  "validation", file=sys.stderr)
+            return rc
+        # -- leg c: the online tuner's unit matrix (apply -> measure ->
+        # keep/revert, injected regression, endorsement gating). Plain env:
+        # tests/conftest.py owns its own 8-device XLA_FLAGS.
+        rc = subprocess.call(
+            [
+                sys.executable, "-m", "pytest", "-q",
+                "tests/test_tune.py", "-k", "fleet",
+                "-p", "no:cacheprovider",
+            ],
+            cwd=REPO, env=env,
+        )
+        if rc != 0:
+            print("autotune gate: fleet tuner unit matrix failed",
+                  file=sys.stderr)
+            return rc
+        print(
+            f"autotune gate: advisor fired rule classes {classes} on the "
+            "bad-knob run, A/B probe report schema-v12 valid, fleet "
+            "apply/measure/revert matrix green"
+        )
+    return 0
+
+
 def main(argv=None):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")  # the full gate never needs a real TPU
@@ -1645,7 +1769,10 @@ def main(argv=None):
     rc = _observability_gate(env)
     if rc != 0:
         return rc
-    return _tracing_gate(env)
+    rc = _tracing_gate(env)
+    if rc != 0:
+        return rc
+    return _autotune_gate(env)
 
 
 if __name__ == "__main__":

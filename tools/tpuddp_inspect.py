@@ -34,10 +34,22 @@ Checkpoint subcommands (numpy, no jax — both run on analysis hosts):
   manifest — what ``training.reshard_on_mismatch: true`` does at load
   time, runnable before the relaunch instead.
 
+Advisor subcommand (pure python — the whole CLI runs without jax):
+
+- ``tune <run_dir>`` — the offline evidence engine
+  (tpuddp/observability/advisor.py): parse the run's history, traces, and
+  writer sidecars into typed evidence and print knob recommendations with
+  per-rule evidence citations + predicted deltas. ``--emit PATH`` writes
+  the tuned ``$TPUDDP_TUNE_OVERLAY`` payload; ``--json`` is the
+  machine-readable report. Read-only: inspecting a run never changes it.
+  TUNE_r*.json probe artifacts (tools/autotune.py) validate and summarize
+  through the bare-path mode like every other artifact.
+
 Usage:
     python tools/tpuddp_inspect.py <path> [--validate] [--events]
     python tools/tpuddp_inspect.py ckpt <file-or-dir>
     python tools/tpuddp_inspect.py reshard <src> --to data=D,model=M
+    python tools/tpuddp_inspect.py tune <run_dir> [--emit PATH] [--json]
 
 ``--validate`` checks the schema only (exit 0 valid / 1 invalid, errors on
 stderr) — the mode ``tools/run_full_gate.py`` runs over the dryrun history
@@ -85,6 +97,19 @@ def _load_reshard():
     return mod
 
 
+def _load_advisor():
+    """Load tpuddp/observability/advisor.py by file path (pure stdlib —
+    the evidence engine reads artifacts, never the runtime), so the
+    ``tune`` subcommand works on analysis hosts without jax."""
+    path = os.path.join(_REPO, "tpuddp", "observability", "advisor.py")
+    spec = importlib.util.spec_from_file_location(
+        "_tpuddp_inspect_advisor", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _load_integrity():
     """tpuddp/resilience/integrity.py by file path (stdlib-only module)."""
     path = os.path.join(_REPO, "tpuddp", "resilience", "integrity.py")
@@ -113,6 +138,8 @@ def _detect_kind(path: str) -> str:
         return "flight"
     if isinstance(obj, dict) and "traceEvents" in obj:
         return "trace"
+    if isinstance(obj, dict) and obj.get("type") == "tune_report":
+        return "tune"
     if isinstance(obj, dict) and "configs" in obj and "metric" in obj:
         return "bench"
     return "history"
@@ -128,6 +155,25 @@ def _read_history(path: str):
                 except ValueError:
                     records.append({"type": "<unparseable>"})
     return records
+
+
+def _writer_sidecars(run_dir: str):
+    """Every parseable ``*.writer.json`` under ``run_dir`` (the async
+    snapshot writer's per-publish statistics sidecars), recursive so
+    peer_ckpt/ spill copies count too."""
+    import glob as _glob
+
+    out = []
+    pattern = os.path.join(_glob.escape(run_dir), "**", "*.writer.json")
+    for p in sorted(_glob.glob(pattern, recursive=True)):
+        try:
+            with open(p) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(payload, dict):
+            out.append(payload)
+    return out
 
 
 def _fmt(v, nd=4):
@@ -292,6 +338,25 @@ def summarize_history(path: str) -> None:
         if any(v for v in host_stall_epoch):
             print(f"host stall per epoch (ms): "
                   f"{[round(v, 1) for v in host_stall_epoch if v is not None]}")
+
+    # async-writer sidecar rollup: every ckpt_*.npz.writer.json beside the
+    # history (the snapshot engine's per-publish statistics — the same
+    # sidecar `ckpt` prints next to the v4 cursor, aggregated run-wide
+    # here so backlog shows up without opening each checkpoint)
+    sidecars = _writer_sidecars(os.path.dirname(os.path.abspath(path)))
+    if sidecars:
+        snaps = sum(int(w.get("snapshots") or 0) for w in sidecars)
+        skipped = sum(int(w.get("skipped_queue_full") or 0) for w in sidecars)
+        write_s = sum(float(w.get("write_s") or 0.0) for w in sidecars)
+        total_b = sum(int(w.get("bytes") or 0) for w in sidecars)
+        n_async = sum(1 for w in sidecars if w.get("async"))
+        line = (f"\nsnapshot writer: {len(sidecars)} sidecar(s) "
+                f"({n_async} async), {snaps} snapshot(s), "
+                f"{skipped} skipped_queue_full, "
+                f"{write_s:.2f} s writing, {total_b:,} B")
+        if skipped:
+            line += "  <- backlog: writer dropped snapshots (queue full)"
+        print(line)
 
     if serving:
         print(f"\nserving_stats windows ({len(serving)}):")
@@ -657,6 +722,81 @@ def summarize_bench(path: str) -> None:
     ])
 
 
+def summarize_tune(path: str) -> None:
+    """Pretty-print a TUNE_r*.json A/B probe report (schema v12): the
+    predicted-vs-measured delta per rule and the endorsement verdicts."""
+    with open(path) as f:
+        payload = json.load(f)
+    print(f"tune report: mode={payload.get('mode')} "
+          f"device={payload.get('device')} "
+          f"(schema v{payload.get('schema_version')})")
+    baseline = payload.get("baseline_metrics") or {}
+    if baseline:
+        print("  baseline: " + ", ".join(
+            f"{k}={_fmt(v, 2)}" for k, v in sorted(baseline.items())
+        ))
+    results = payload.get("results") or []
+    rows = []
+    for r in results:
+        rows.append([
+            str(r.get("rule")),
+            str(r.get("rule_class")),
+            str(r.get("metric")),
+            _fmt(r.get("predicted_delta_pct"), 1),
+            _fmt(r.get("measured_delta_pct"), 1),
+            "yes" if r.get("endorsed") else "NO",
+        ])
+    if rows:
+        _print_table(rows, [
+            "rule", "class", "metric", "pred%", "meas%", "endorsed",
+        ])
+    n_endorsed = sum(1 for r in results if r.get("endorsed"))
+    print(f"  {n_endorsed}/{len(results)} endorsed (measured improvement "
+          "only — a regressing diff is never endorsed, whatever was "
+          "predicted)")
+
+
+def tune_main(argv) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpuddp_inspect.py tune",
+        description="Offline advisor: read a run dir's history.jsonl, "
+        "trace_*.json, and writer sidecars, and print knob recommendations "
+        "with evidence citations + predicted deltas. Read-only — nothing "
+        "is applied unless you --emit an overlay and launch with it.",
+    )
+    parser.add_argument("run_dir", help="run directory (holds history.jsonl)")
+    parser.add_argument(
+        "--emit", metavar="PATH", default=None,
+        help="write the tuned config overlay (the $TPUDDP_TUNE_OVERLAY "
+        "payload) for the recommendations to PATH",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="print the full report as JSON (machine-readable)",
+    )
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"no such run dir: {args.run_dir}", file=sys.stderr)
+        return 2
+    advisor = _load_advisor()
+    report = advisor.advise(args.run_dir)
+    if args.as_json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(advisor.format_report(report))
+    if args.emit:
+        overlay = advisor.overlay_from(report["recommendations"])
+        overlay["source"] = "advisor"
+        tmp = args.emit + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(overlay, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, args.emit)
+        print(f"\noverlay written: {args.emit} "
+              f"(launch with TPUDDP_TUNE_OVERLAY=\"$(cat {args.emit})\")")
+    return 0
+
+
 def summarize_ckpt(path: str) -> int:
     """Print one checkpoint's recorded topology, shard tags, placement
     table, v4 data cursor (step snapshots), writer statistics, peer-shard
@@ -875,6 +1015,8 @@ def main(argv=None) -> int:
         return ckpt_main(argv[1:])
     if argv and argv[0] == "reshard":
         return reshard_main(argv[1:])
+    if argv and argv[0] == "tune":
+        return tune_main(argv[1:])
     # `tpuddp_inspect.py trace <path>` — the explicit trace subcommand:
     # validates the artifact against schema v9 and prints the slowest-span
     # table + per-kind time share (content detection still recognizes a
@@ -910,6 +1052,8 @@ def main(argv=None) -> int:
         errors, n = schema.validate_flight_file(args.path)
     elif kind == "trace":
         errors, n = schema.validate_trace_file(args.path)
+    elif kind == "tune":
+        errors, n = schema.validate_tune_file(args.path)
     else:
         errors, n = schema.validate_history_file(args.path)
 
@@ -931,6 +1075,8 @@ def main(argv=None) -> int:
         summarize_flight(args.path)
     elif kind == "trace":
         summarize_trace(args.path)
+    elif kind == "tune":
+        summarize_tune(args.path)
     elif args.events:
         for r in _read_history(args.path):
             if r.get("event"):

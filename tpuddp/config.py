@@ -257,12 +257,16 @@ def decode_config(serving: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     if block is None or block is False:
         return None
     if block is True:
-        return dict(DECODE_DEFAULTS)
+        cfg = dict(DECODE_DEFAULTS)
+        cfg, _ = apply_tune_overlay(cfg, section="decode")
+        return cfg
     if not isinstance(block, dict):
         raise ValueError(
             f"serving.decode must be a mapping or bool, got {block!r}"
         )
-    return _merge_refusing_unknown(DECODE_DEFAULTS, block, "serving.decode")
+    cfg = _merge_refusing_unknown(DECODE_DEFAULTS, block, "serving.decode")
+    cfg, _ = apply_tune_overlay(cfg, section="decode")
+    return cfg
 
 
 # Live telemetry plane knobs (tpuddp/observability/{exporter,aggregate,
@@ -299,6 +303,14 @@ OBSERVABILITY_DEFAULTS = {
     # fences, HLO and loss trajectory identical tracing on/off.
     "trace_capacity": 4096,  # completed-span ring length per process
     # (oldest spans dropped past it, counted in the trace_summary record)
+    "advisor": False,  # arm the autotuning advisor's crash hook
+    # (observability/advisor.py): on preempt/exception the flight recorder
+    # dumps the PENDING (unendorsed) knob recommendation over this run dir
+    # as a `pending_tune` context block, so a crash never silently discards
+    # the evidence that was about to be acted on. Read-only: the advisor
+    # never changes a knob itself — applying one is $TPUDDP_TUNE_OVERLAY's
+    # job (the fleet tuner / tools/autotune.py), and advisor-off runs are
+    # bitwise- and HLO-identical to pre-advisor behavior.
 }
 
 
@@ -479,14 +491,120 @@ def serving_config(settings: Dict[str, Any]) -> Dict[str, Any]:
     way ``$TPUDDP_WORLD_SIZE`` overrides the training world
     (:func:`world_size_from`): the fleet controller resizes a serving job
     by draining it (exit 75) and relaunching the same command with this
-    set — one elastic contract for both job kinds."""
+    set — one elastic contract for both job kinds. A ``serving`` section of
+    ``$TPUDDP_TUNE_OVERLAY`` (the fleet tuner's knob lever) merges last."""
     cfg = _merge_refusing_unknown(
         SERVING_DEFAULTS, settings.get("serving") or {}, "serving"
     )
     env = os.environ.get("TPUDDP_SERVING_REPLICAS")
     if env:
         cfg["num_replicas"] = int(env)
+    cfg, _ = apply_tune_overlay(cfg, section="serving")
     return cfg
+
+
+# ---------------------------------------------------------- tune overlay --
+# The fleet tuner's knob lever (tpuddp/tune/online.py): a JSON object in
+# this env var carries per-section config diffs plus the provenance fields
+# that land in run_meta.tuning. It rides the drain-and-relaunch contract
+# the way $TPUDDP_WORLD_SIZE does — the controller mutates the supervisor's
+# env and SIGTERMs the child; the relaunch resolves its config THROUGH the
+# overlay. Absent env = advisor off = bitwise-identical config resolution.
+TUNE_OVERLAY_ENV = "TPUDDP_TUNE_OVERLAY"
+_TUNE_OVERLAY_SECTIONS = ("training", "serving", "decode")
+
+
+def _tune_overlay() -> Optional[Dict[str, Any]]:
+    """Parse ``$TPUDDP_TUNE_OVERLAY``; None when unset. A garbled overlay
+    refuses loudly — silently training the BASELINE config while run_meta
+    claims a tuned one would poison every downstream A/B comparison."""
+    raw = os.environ.get(TUNE_OVERLAY_ENV)
+    if not raw:
+        return None
+    import json
+
+    try:
+        overlay = json.loads(raw)
+    except ValueError as e:
+        raise ValueError(f"${TUNE_OVERLAY_ENV} is not valid JSON: {e}")
+    if not isinstance(overlay, dict):
+        raise ValueError(
+            f"${TUNE_OVERLAY_ENV} must be a JSON object, got {overlay!r}"
+        )
+    unknown = set(overlay) - set(_TUNE_OVERLAY_SECTIONS) - {
+        "source", "rule", "generation"
+    }
+    if unknown:
+        raise ValueError(
+            f"unknown ${TUNE_OVERLAY_ENV} key(s) {sorted(unknown)}; expected "
+            f"sections {_TUNE_OVERLAY_SECTIONS} plus source/rule/generation"
+        )
+    return overlay
+
+
+def apply_tune_overlay(
+    cfg: Dict[str, Any], section: str = "training"
+) -> tuple:
+    """Merge ``$TPUDDP_TUNE_OVERLAY``'s ``section`` diff over a RESOLVED
+    config dict. Returns ``(config, tuning_provenance)`` — provenance is
+    None when no overlay is set (the advisor-off identity path: the input
+    dict is returned untouched, not copied). Unknown knobs refuse with the
+    config system's did-you-mean contract; dict-valued knobs (pipeline,
+    snapshot, guard) merge shallowly so a one-field diff does not clobber
+    its siblings."""
+    overlay = _tune_overlay()
+    if overlay is None:
+        return cfg, None
+    diff = overlay.get(section) or {}
+    if not isinstance(diff, dict):
+        raise ValueError(
+            f"${TUNE_OVERLAY_ENV}.{section} must be an object, got {diff!r}"
+        )
+    merged = dict(cfg)
+    if diff:
+        # knob names validate against the SECTION's full default set, not
+        # just the incoming dict — callers hand partial dicts (a worker's
+        # hand-built training block) and a knob absent from the partial is
+        # still a real knob the overlay may set
+        defaults = {
+            "training": TRAINING_DEFAULTS,
+            "serving": SERVING_DEFAULTS,
+            "decode": DECODE_DEFAULTS,
+        }.get(section) or {}
+        known = set(defaults) | set(cfg)
+        unknown = set(diff) - known
+        if unknown:
+            raise ValueError(
+                f"${TUNE_OVERLAY_ENV}.{section} carries unknown knob(s) "
+                f"{sorted(unknown)}; known: {sorted(known)}"
+            )
+        for knob, value in diff.items():
+            if isinstance(value, dict) and isinstance(merged.get(knob), dict):
+                merged[knob] = {**merged[knob], **value}
+            else:
+                merged[knob] = value
+    return merged, tuning_provenance_from_env(section=section)
+
+
+def tuning_provenance_from_env(section: str = "training") -> Optional[dict]:
+    """The ``run_meta.tuning`` block (schema v12): which overlay this run's
+    knobs came from. None (the required key's null value) when no overlay
+    is set — a reader must distinguish "human-chosen knobs" from "the fleet
+    tuner's generation-N diff"."""
+    overlay = _tune_overlay()
+    if overlay is None:
+        return None
+    return {
+        "source": overlay.get("source") or "overlay",
+        "rule": overlay.get("rule"),
+        "generation": overlay.get("generation"),
+        "applied": {
+            sec: overlay[sec]
+            for sec in _TUNE_OVERLAY_SECTIONS
+            if isinstance(overlay.get(sec), dict) and overlay[sec]
+        },
+        "section": section,
+    }
 
 
 # Label-space size by dataset name; the reference hardcodes 10 because its only
@@ -675,6 +793,8 @@ def training_config(settings: Dict[str, Any]) -> Dict[str, Any]:
     Unknown keys are REFUSED with a did-you-mean hint — a typo'd knob
     (``wieght_update_sharding``) silently ignored would train a different
     configuration than the file says."""
-    return _merge_refusing_unknown(
+    cfg = _merge_refusing_unknown(
         TRAINING_DEFAULTS, settings.get("training") or {}, "training"
     )
+    cfg, _ = apply_tune_overlay(cfg, section="training")
+    return cfg

@@ -30,6 +30,7 @@ rc the supervisor classifies by signal name.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import signal
@@ -159,15 +160,25 @@ class FleetController:
         max_jobs: int = 16,
         supervisor_policy: Optional[SupervisorPolicy] = None,
         autoscaler=None,
+        tuner=None,
         env: Optional[Dict[str, str]] = None,
         drain_grace: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
         observability: Optional[dict] = None,
     ):
-        """``observability``: the live-plane block (config shape); today the
-        controller consumes only its ``tracing`` knobs — one job-lifecycle
-        span per submitted job (start/resize/preempt action children),
-        exported as ``trace_fleet.json`` at shutdown."""
+        """``observability``: the live-plane block (config shape); the
+        controller consumes its ``tracing`` knobs — one job-lifecycle
+        span per submitted job (start/resize/preempt/tune action children),
+        exported as ``trace_fleet.json`` at shutdown — and, with
+        ``exporter: true``, serves a fleet-level /metrics endpoint carrying
+        the tuner's ``tpuddp_tune_*`` counters.
+
+        ``tuner`` (optional, a :class:`tpuddp.tune.online.FleetTuner`)
+        closes the observe->advise->act loop: its decisions apply by
+        mutating the job supervisor's ``$TPUDDP_TUNE_OVERLAY`` env and
+        draining the child — the same exit-75 relaunch contract resizes
+        ride, so a knob change is exactly as disruptive as a resize and
+        never less safe."""
         if pool_size < 1:
             raise ValueError(f"pool_size must be >= 1, got {pool_size}")
         self.pool_size = int(pool_size)
@@ -177,6 +188,7 @@ class FleetController:
             backoff_base=0.5, backoff_cap=5.0
         )
         self.autoscaler = autoscaler
+        self.tuner = tuner
         self.env = dict(env or {})
         self.drain_grace = drain_grace
         self.clock = clock
@@ -185,11 +197,21 @@ class FleetController:
         self._arrivals = 0
         self.last_plan: Optional[Plan] = None
         from tpuddp import config as cfg_lib
+        from tpuddp.observability import exporter as exp_lib
 
+        obs_cfg = cfg_lib.resolve_observability(observability)
         self.tracer = trace_lib.tracer_from_config(
-            cfg_lib.resolve_observability(observability), "fleet",
-            run_dir=fleet_dir,
+            obs_cfg, "fleet", run_dir=fleet_dir,
         )
+        self.exporter = exp_lib.exporter_from_config(
+            obs_cfg, run_dir=fleet_dir
+        )
+        if self.exporter is not None:
+            self.exporter.start()
+            if self.tuner is not None:
+                self.exporter.register_source(
+                    "tune", self.tuner.export_source
+                )
         os.makedirs(os.path.join(fleet_dir, "jobs"), exist_ok=True)
 
     # -------------------------------------------------------------- admit --
@@ -382,6 +404,45 @@ class FleetController:
         job.resizes += 1
         self._signal_drain(job)
 
+    def _apply_tune(self, job: ManagedJob, decision: dict, now: float) -> None:
+        """Commit one tuner decision: mutate the supervisor's
+        ``$TPUDDP_TUNE_OVERLAY`` (consumed by ``_child_env`` at every
+        attempt) and drain the child so the relaunch resolves its config
+        THROUGH the overlay. ``keep`` endorses the live overlay in place —
+        no env change, no drain. The tuner's own state machine advances in
+        ``mark_applied`` (which also lands the ``tune_action`` history
+        event), called only after the env mutation is really in."""
+        from tpuddp import config as cfg_lib
+
+        sup = job.supervisor
+        if sup is None:
+            return
+        action = decision["action"]
+        self.tracer.end_span(self.tracer.start_span(
+            f"tune_{action}", trace_lib.KIND_ACTION, parent=job.trace_span,
+            attrs={
+                "rule": decision.get("rule"),
+                "generation": decision.get("generation"),
+                "measured_delta_pct": decision.get("measured_delta_pct"),
+            },
+        ))
+        if action in ("apply", "revert"):
+            overlay_env = decision.get("overlay_env")
+            if overlay_env is not None:
+                sup.env[cfg_lib.TUNE_OVERLAY_ENV] = json.dumps(
+                    overlay_env, sort_keys=True
+                )
+            else:
+                # revert to the pristine config: no kept overlay remains
+                sup.env.pop(cfg_lib.TUNE_OVERLAY_ENV, None)
+            logger.warning(
+                "fleet: tune %s on %s (rule %s, gen %s) via the drain "
+                "contract", action, job.spec.name, decision.get("rule"),
+                decision.get("generation"),
+            )
+            self._signal_drain(job)
+        self.tuner.mark_applied(job.spec.name, job.run_dir, decision, now)
+
     def _preempt(self, job: ManagedJob, by: Optional[str] = None) -> None:
         if job.stopping or job.supervisor is None:
             return
@@ -454,6 +515,23 @@ class FleetController:
                 )
                 if proposal is not None:
                     proposals[name] = proposal
+        # tuner decisions read run-dir artifacts (history/trace files) —
+        # same outside-the-lock rule as the autoscaler's scrapes; the
+        # decisions are re-checked against job state before applying
+        tune_decisions: List[tuple] = []
+        if self.tuner is not None:
+            with self._lock:
+                tune_targets = [
+                    (j.spec.name, j.spec.kind, j.run_dir)
+                    for j in self.jobs.values()
+                    if j.state == RUNNING and not j.stopping
+                ]
+            for name, kind, run_dir in tune_targets:
+                decision = self.tuner.observe_and_decide(
+                    name, kind, run_dir, now=now
+                )
+                if decision is not None:
+                    tune_decisions.append((name, decision))
         with self._lock:
             # reap: threads that returned already set their final state
             for job in self.jobs.values():
@@ -519,6 +597,11 @@ class FleetController:
                     self._preempt(job, by=displacer)
                 elif action == "keep":
                     job.slice = plan.slices[name]
+            for name, decision in tune_decisions:
+                job = self.jobs.get(name)
+                if job is None or job.state != RUNNING or job.stopping:
+                    continue  # the job left while we were deciding
+                self._apply_tune(job, decision, now)
             self._escalate_expired_drains(now)
             return plan
 
@@ -585,7 +668,7 @@ class FleetController:
                     if j.thread is not None and j.thread.is_alive()
                 ]
             if not alive:
-                self.tracer.export()
+                self._shutdown_telemetry()
                 return
             time.sleep(0.2)
         for j in alive:  # last resort: the escalation path already SIGKILLed
@@ -593,7 +676,12 @@ class FleetController:
                 "fleet: %s supervisor thread still alive at shutdown "
                 "timeout", j.spec.name,
             )
+        self._shutdown_telemetry()
+
+    def _shutdown_telemetry(self) -> None:
         self.tracer.export()
+        if self.exporter is not None:
+            self.exporter.stop()
 
     def status(self) -> List[dict]:
         with self._lock:

@@ -81,7 +81,16 @@ index), so a reader of a resumed history can tell which snapshot
 cadence produced the checkpoint family it restored from. ``false`` =
 the engine was off (epoch-granular checkpoints only); the KEY must
 exist — absence is drift, and a reader must distinguish "no step
-snapshots because the engine was off" from "predates the engine".
+snapshots because the engine was off" from "predates the engine";
+v12 added the autotuning plane (tpuddp/observability/advisor.py +
+tpuddp/tune/): the required run_meta ``tuning`` provenance field (null =
+advisor off — a tuned-off run must be bitwise-identical to a pre-v12
+run; an armed block names the overlay source, rule and generation that
+produced the knobs this run trained under), the ``tune_report`` record
+type (the ``TUNE_r*.json`` A/B probe artifact: per-rule predicted vs
+measured deltas + endorsement verdicts, :func:`validate_tune_payload`)
+and the typed ``tune_action`` event rows the fleet tuner appends when it
+applies or reverts a knob change through drain-and-relaunch.
 Readers accept every version up to their own ``SCHEMA_VERSION`` and
 reject newer files; the per-version required-field sets apply at the
 version each record CARRIES, so a v2 history (no occupancy fields) stays
@@ -95,11 +104,11 @@ import hashlib
 import json
 from typing import Dict, Iterable, List, Optional, Tuple
 
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 
 RECORD_TYPES = (
     "run_meta", "epoch", "step_stats", "event", "serving_stats",
-    "decode_stats", "trace_summary",
+    "decode_stats", "trace_summary", "tune_report",
 )
 
 # Required keys per record type (beyond the envelope's type/schema_version).
@@ -187,6 +196,19 @@ _REQUIRED = {
         "by_kind",
         "slowest",
     ),
+    # the autotuner's A/B probe artifact (schema v12, tools/autotune.py +
+    # tpuddp/tune/probe.py): ONE JSON object — baseline metrics plus one
+    # row per advisor rule carrying the predicted delta it promised, the
+    # measured delta the probe observed, and the endorsement verdict. The
+    # measured field is the honesty contract: a rule whose measured delta
+    # regresses MUST carry endorsed=false, so the fleet tuner never acts
+    # on a prediction that failed its own A/B.
+    "tune_report": (
+        "device",
+        "mode",
+        "baseline_metrics",
+        "results",
+    ),
 }
 
 # Fields additionally required of records stamped at schema_version >= N:
@@ -267,6 +289,17 @@ _REQUIRED_SINCE = {
     11: {
         "run_meta": ("snapshot",),
     },
+    # v12: the autotuning plane's provenance (``tuning``, tpuddp/tune/).
+    # Null for every untuned writer (the default — the advisor is read-only
+    # until a human or the fleet tuner applies an overlay) but the KEY must
+    # exist: a reader needs to distinguish "these knobs were human-chosen"
+    # from "this header predates the autotuner". An armed block names the
+    # overlay source (fleet/operator), the rule that proposed it, the
+    # overlay generation counter, and the knob diff actually applied — so a
+    # before/after pair of resumed headers is self-explaining.
+    12: {
+        "run_meta": ("tuning",),
+    },
 }
 
 def stamp(record_type: str, record: dict) -> dict:
@@ -303,6 +336,7 @@ def make_run_meta(
     tracing: Optional[dict] = None,
     comm: Optional[dict] = None,
     snapshot=None,
+    tuning: Optional[dict] = None,
     extra: Optional[dict] = None,
 ) -> dict:
     """Build the run_meta header row from live run objects.
@@ -386,6 +420,10 @@ def make_run_meta(
         # provenance — resolved config + writer identity when armed, False
         # when off (epoch-granular checkpoints only)
         "snapshot": False if snapshot is None else snapshot,
+        # required since schema v12: the autotuning plane's provenance —
+        # the overlay source/rule/generation + knob diff this run trained
+        # under (null = advisor off, the run's knobs were human-chosen)
+        "tuning": tuning,
     }
     if extra:
         record.update(extra)
@@ -716,6 +754,116 @@ def validate_trace_file(path: str) -> Tuple[List[str], int]:
             1 for e in payload["traceEvents"]
             if isinstance(e, dict) and e.get("ph") == "X"
         )
+    return errors, n
+
+
+# Tune artifact (TUNE_r*.json) — the autotuner's A/B probe report
+# (schema v12, tools/autotune.py + tpuddp/tune/probe.py). ONE JSON object
+# stamped ``type: tune_report``: envelope + baseline metrics + one result
+# row per advisor rule probed.
+TUNE_MODES = ("train", "serving")
+_TUNE_ROW_REQUIRED = (
+    "rule",
+    "rule_class",
+    "knob",
+    "diff",
+    "metric",
+    "predicted_delta_pct",
+    "measured_delta_pct",
+    "endorsed",
+    "evidence",
+)
+TUNE_RULE_CLASSES = ("pipeline", "comm", "snapshot", "serving")
+
+
+def validate_tune_payload(payload) -> List[str]:
+    """Schema errors for a ``TUNE_r*.json`` payload (empty = valid).
+
+    The endorsement contract is validated, not just typed: a row whose
+    ``measured_delta_pct`` is negative (a regression on its own metric)
+    must not carry ``endorsed: true`` — the whole point of the artifact is
+    that the fleet never applies a knob the probe watched regress."""
+    if not isinstance(payload, dict):
+        return ["tune payload is not a JSON object"]
+    errors = []
+    if payload.get("type") != "tune_report":
+        errors.append(
+            f"'type' must be 'tune_report', got {payload.get('type')!r}"
+        )
+    version = payload.get("schema_version")
+    if not isinstance(version, int) or version < 12:
+        errors.append(
+            f"schema_version {version!r} is not an int >= 12 (tune reports "
+            "were introduced at v12)"
+        )
+    elif version > SCHEMA_VERSION:
+        errors.append(
+            f"schema_version {version} is newer than this reader's "
+            f"{SCHEMA_VERSION}"
+        )
+    errors += [
+        f"missing field {k!r}"
+        for k in _REQUIRED["tune_report"]
+        if k not in payload
+    ]
+    if "mode" in payload and payload.get("mode") not in TUNE_MODES:
+        errors.append(
+            f"unknown mode {payload.get('mode')!r}; expected one of {TUNE_MODES}"
+        )
+    baseline = payload.get("baseline_metrics")
+    if "baseline_metrics" in payload and not isinstance(baseline, dict):
+        errors.append("'baseline_metrics' must be an object of metric -> value")
+    results = payload.get("results")
+    if results is None:
+        return errors
+    if not isinstance(results, list):
+        return errors + ["'results' must be a list of rule rows"]
+    for i, row in enumerate(results):
+        if not isinstance(row, dict):
+            errors.append(f"result {i}: not an object")
+            continue
+        missing = [k for k in _TUNE_ROW_REQUIRED if k not in row]
+        if missing:
+            errors.append(f"result {i}: missing field(s) {missing}")
+        rclass = row.get("rule_class")
+        if "rule_class" in row and rclass not in TUNE_RULE_CLASSES:
+            errors.append(
+                f"result {i}: unknown rule_class {rclass!r}; expected one "
+                f"of {TUNE_RULE_CLASSES}"
+            )
+        if "diff" in row and not isinstance(row.get("diff"), dict):
+            errors.append(f"result {i}: 'diff' must be a config-diff object")
+        if "evidence" in row and not isinstance(row.get("evidence"), list):
+            errors.append(f"result {i}: 'evidence' must be a list of citations")
+        measured = row.get("measured_delta_pct")
+        if (
+            isinstance(measured, (int, float))
+            and measured < 0
+            and row.get("endorsed") is True
+        ):
+            errors.append(
+                f"result {i}: endorsed=true with a regressing measured "
+                f"delta ({measured:+.2f}%) — the probe must refuse"
+            )
+    return errors
+
+
+def validate_tune_file(path: str) -> Tuple[List[str], int]:
+    """Parse + validate a ``TUNE_r*.json`` artifact. Returns
+    ``(errors, n_result_rows)``; non-strict JSON is itself an error."""
+
+    def _reject(token):
+        raise ValueError(f"non-strict JSON token {token}")
+
+    try:
+        with open(path) as f:
+            payload = json.load(f, parse_constant=_reject)
+    except (OSError, ValueError) as e:
+        return [f"cannot parse {path}: {e}"], 0
+    errors = validate_tune_payload(payload)
+    n = 0
+    if isinstance(payload, dict) and isinstance(payload.get("results"), list):
+        n = len(payload["results"])
     return errors, n
 
 

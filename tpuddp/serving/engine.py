@@ -150,6 +150,7 @@ class ServingEngine:
     def start(self, warmup: bool = True) -> "ServingEngine":
         if self._started:
             return self
+        from tpuddp import config as cfg_lib
         if self.exporter is not None:
             # bind before the header so run_meta records the real port
             self.exporter.start()
@@ -180,6 +181,8 @@ class ServingEngine:
                     },
                     survivability=self.survive.meta(),
                     tracing=self.tracer.describe(),
+                    # v12: overlay provenance (null = no tune overlay)
+                    tuning=cfg_lib.tuning_provenance_from_env("serving"),
                     extra={
                         "api": "serving",
                         "model": cfg.get("model"),

@@ -290,6 +290,15 @@ def run_training_loop(
             # a crash dump embeds the still-open spans: the exact stage the
             # process died in, not just the last flushed window
             flight.add_context("open_spans", tracer.open_span_summaries)
+        if obs_cfg.get("advisor") or os.environ.get(cfg_lib.TUNE_OVERLAY_ENV):
+            # advisor-armed runs: a preempt/crash must not lose the pending
+            # recommendation — the dump carries the top advice so the next
+            # launch (or a human) can act on what this run already learned
+            from tpuddp.observability import advisor as advisor_lib
+            flight.add_context(
+                "pending_tune",
+                lambda: advisor_lib.pending_summary(save_dir),
+            )
     metrics_writer = MetricsWriter(save_dir, flight=flight)
     # ---- async step-granular snapshots (training/snapshot.py): the engine
     # copies state on-device between dispatches and serializes on a
@@ -475,6 +484,10 @@ def run_training_loop(
             else (snap_cfg.as_dict() if snap_cfg.enabled else False)
         ),
         comm=comm_block,
+        # v12 tuning block: tune-overlay provenance when this process was
+        # relaunched under $TPUDDP_TUNE_OVERLAY (null = advisor off / no
+        # overlay — the bitwise-identical default)
+        tuning=cfg_lib.tuning_provenance_from_env(),
         extra=meta_extra,
     ))
     for ev in reshard_log:

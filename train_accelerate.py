@@ -386,6 +386,9 @@ def run_training_loop(
             {**snap_cfg.as_dict(), "mode": "drain"}
             if snap_cfg.enabled else False
         ),
+        # v12 tuning provenance: which overlay (if any) shaped this run's
+        # knobs; null = advisor off / no overlay
+        tuning=cfg_lib.tuning_provenance_from_env(),
         extra=meta_extra,
     ))
     for ev in restore_events:

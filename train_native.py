@@ -51,6 +51,10 @@ def basic_ddp_training_loop(
     process group is already up (run_ddp_training called setup)."""
     print(f"Running DDP training on process {rank} ({world_size}-chip world).")
     training = training or cfg_lib.TRAINING_DEFAULTS
+    # Tune overlay ($TPUDDP_TUNE_OVERLAY) applies here too so workers handed
+    # a pre-resolved training dict (fleet relaunch, chaos harness) pick it
+    # up; re-application after training_config is an idempotent merge.
+    training, _tune_prov = cfg_lib.apply_tune_overlay(training, section="training")
 
     # Seeds per rank (reference :234); the data permutation seed stays shared
     # across ranks (DistributedSampler contract) and independent of model seed.
